@@ -1,0 +1,25 @@
+"""ceph_tpu — a TPU-native framework for Ceph's compute-bound hot paths.
+
+Re-implements, from scratch and TPU-first (JAX/XLA/Pallas), the two
+embarrassingly-parallel kernels of the Ceph reference
+(/root/reference, juztas/ceph):
+
+1. CRUSH placement — the PG->OSD mapping pipeline
+   (OSDMap::_pg_to_raw_osds -> crush_do_rule -> bucket_straw2_choose),
+   batched over millions of PGs as one vmapped/pjit-sharded XLA call.
+2. Erasure coding — Reed-Solomon / Clay encode+decode as batched GF(2^8)
+   linear algebra on the MXU (bit-plane GF(2) matmuls / Pallas kernels).
+
+All placement math is bit-exact with the C reference semantics
+(src/crush/mapper.c, src/crush/hash.c, src/osd/OSDMap.cc), which is the
+correctness oracle; architecture is idiomatic JAX, not a port.
+
+The whole domain is integer math (uint32 hashes, s64 fixed-point logs), so
+the package enables jax_enable_x64 at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+from ceph_tpu.core.rjenkins import (
+    crush_hash32,
+    crush_hash32_2,
+    crush_hash32_3,
+    crush_hash32_4,
+    crush_hash32_5,
+    HASH_SEED,
+)
+from ceph_tpu.core.lntable import crush_ln, RH_LH_TBL, LL_TBL
+from ceph_tpu.core.intmath import (
+    stable_mod,
+    div_trunc_s64,
+    div_trunc_int,
+    pg_mask_for,
+)

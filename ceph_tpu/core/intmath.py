@@ -1,0 +1,50 @@
+"""Small integer helpers shared by the placement kernels.
+
+- stable_mod: Ceph's power-of-two-friendly modulo used to fold the object
+  hash onto pg_num (reference src/include/rados.h:96-102).  Chosen so that
+  growing b from 2^(n-1) to 2^n moves each bucket's contents at most once.
+- div_trunc_s64: C-style s64 division truncating toward zero (the semantics
+  of div64_s64 used by straw2, reference src/crush/mapper.c:358).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pg_mask_for(b: int) -> int:
+    """bmask = next_pow2(b) - 1, e.g. b=12 -> 15 (pg_num_mask semantics,
+    reference src/osd/osd_types.h calc_pg_masks)."""
+    if b <= 0:
+        return 0
+    return (1 << (int(b) - 1).bit_length()) - 1
+
+
+def stable_mod(x, b, bmask, xp=np):
+    """ceph_stable_mod(x, b, bmask) (reference src/include/rados.h:96-102)."""
+    x = xp.asarray(x).astype(xp.uint32)
+    b = xp.asarray(b).astype(xp.uint32)
+    bmask = xp.asarray(bmask).astype(xp.uint32)
+    lo = x & bmask
+    return xp.where(lo < b, lo, x & (bmask >> 1))
+
+
+def div_trunc_int(a: int, w: int) -> int:
+    """Scalar div64_s64: truncate toward zero, for Python ints (hot path of
+    the host oracle; the array version below serves numpy/jax)."""
+    q = abs(a) // abs(w)
+    return -q if (a < 0) != (w < 0) else q
+
+
+def div_trunc_s64(a, w, xp=np):
+    """a // w truncating toward zero, on int64 (a may be negative, w > 0)."""
+    if xp is np:
+        a = np.asarray(a, dtype=np.int64)
+        w = np.asarray(w, dtype=np.int64)
+        q = np.abs(a) // np.abs(w)
+        return np.where((a < 0) != (w < 0), -q, q).astype(np.int64)
+    # jax: lax.div implements C truncating division for integers
+    from jax import lax
+    import jax.numpy as jnp
+
+    return lax.div(jnp.asarray(a, jnp.int64), jnp.asarray(w, jnp.int64))

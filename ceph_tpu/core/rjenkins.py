@@ -1,0 +1,178 @@
+"""Robert Jenkins 32-bit integer mix hash — CRUSH's only RNG.
+
+Bit-exact with the C reference (reference src/crush/hash.c:12-89,
+CRUSH_HASH_RJENKINS1).  Written once over a generic array namespace so the
+same code runs under numpy (host oracle) and jax.numpy (vmapped TPU kernels):
+every operation is a uint32 lattice op (wrapping sub, xor, shifts), which both
+backends implement with identical wraparound semantics.
+
+These are *vectorized*: all arguments broadcast, so hashing a [10M] batch of
+PG seeds is one fused elementwise XLA kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+HASH_SEED = 1315423911  # 0x4E67C6A7, reference src/crush/hash.c:24
+
+
+def _u32(xp, v):
+    return xp.asarray(v).astype(xp.uint32)
+
+
+def _wrapping(fn):
+    """Silence numpy's scalar-overflow RuntimeWarnings: uint32 wraparound is
+    the *point* of this hash.  No effect on the jax path."""
+
+    def wrapper(*args, **kwargs):
+        with np.errstate(over="ignore"):
+            return fn(*args, **kwargs)
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+def _mix(a, b, c):
+    """One round of Jenkins' 96-bit mix (reference src/crush/hash.c:12-22)."""
+    a = (a - b) - c
+    a = a ^ (c >> 13)
+    b = (b - c) - a
+    b = b ^ (a << 8)
+    c = (c - a) - b
+    c = c ^ (b >> 13)
+    a = (a - b) - c
+    a = a ^ (c >> 12)
+    b = (b - c) - a
+    b = b ^ (a << 16)
+    c = (c - a) - b
+    c = c ^ (b >> 5)
+    a = (a - b) - c
+    a = a ^ (c >> 3)
+    b = (b - c) - a
+    b = b ^ (a << 10)
+    c = (c - a) - b
+    c = c ^ (b >> 15)
+    return a, b, c
+
+
+_X = np.uint32(231232)
+_Y = np.uint32(1232)
+
+
+@_wrapping
+def crush_hash32(a, xp=np):
+    """hash of one u32 (reference src/crush/hash.c:26-35)."""
+    a = _u32(xp, a)
+    seed = xp.uint32(HASH_SEED)
+    h = seed ^ a
+    b = a
+    x = _u32(xp, _X)
+    y = _u32(xp, _Y)
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_2(a, b, xp=np):
+    """hash of two u32s (reference src/crush/hash.c:37-46)."""
+    a = _u32(xp, a)
+    b = _u32(xp, b)
+    h = xp.uint32(HASH_SEED) ^ a ^ b
+    x = _u32(xp, _X)
+    y = _u32(xp, _Y)
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_3(a, b, c, xp=np):
+    """hash of three u32s (reference src/crush/hash.c:48-59)."""
+    a = _u32(xp, a)
+    b = _u32(xp, b)
+    c = _u32(xp, c)
+    h = xp.uint32(HASH_SEED) ^ a ^ b ^ c
+    x = _u32(xp, _X)
+    y = _u32(xp, _Y)
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_4(a, b, c, d, xp=np):
+    """hash of four u32s (reference src/crush/hash.c:61-73)."""
+    a = _u32(xp, a)
+    b = _u32(xp, b)
+    c = _u32(xp, c)
+    d = _u32(xp, d)
+    h = xp.uint32(HASH_SEED) ^ a ^ b ^ c ^ d
+    x = _u32(xp, _X)
+    y = _u32(xp, _Y)
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_5(a, b, c, d, e, xp=np):
+    """hash of five u32s (reference src/crush/hash.c:75-90)."""
+    a = _u32(xp, a)
+    b = _u32(xp, b)
+    c = _u32(xp, c)
+    d = _u32(xp, d)
+    e = _u32(xp, e)
+    h = xp.uint32(HASH_SEED) ^ a ^ b ^ c ^ d ^ e
+    x = _u32(xp, _X)
+    y = _u32(xp, _Y)
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return h
+
+
+def str_hash_rjenkins(data: bytes) -> int:
+    """ceph_str_hash_rjenkins over a byte string (object-name hashing).
+
+    Matches the reference's ceph_str_hash(CEPH_STR_HASH_RJENKINS, ...)
+    (reference src/common/ceph_hash.cc) — Jenkins' lookup2-style hash over
+    12-byte blocks with length folded into the tail mix.
+    """
+    a = np.uint32(0x9E3779B9)
+    b = np.uint32(0x9E3779B9)
+    c = np.uint32(0)  # previous hash / arbitrary value
+    n = len(data)
+    i = 0
+    with np.errstate(over="ignore"):
+        while n - i >= 12:
+            a = a + np.uint32(int.from_bytes(data[i : i + 4], "little"))
+            b = b + np.uint32(int.from_bytes(data[i + 4 : i + 8], "little"))
+            c = c + np.uint32(int.from_bytes(data[i + 8 : i + 12], "little"))
+            a, b, c = _mix(a, b, c)
+            i += 12
+        tail = data[i:]
+        c = c + np.uint32(n)
+        # tail bytes: a gets bytes 0-3, b gets 4-7, c gets 8-10 shifted <<8
+        # (byte 11 of c is reserved for the length, as in lookup2)
+        pad = tail + b"\x00" * (12 - len(tail))
+        a = a + np.uint32(int.from_bytes(pad[0:4], "little"))
+        b = b + np.uint32(int.from_bytes(pad[4:8], "little"))
+        c = c + np.uint32(int.from_bytes(pad[8:11], "little") << 8)
+        a, b, c = _mix(a, b, c)
+    return int(c)

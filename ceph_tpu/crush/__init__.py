@@ -1,0 +1,12 @@
+from ceph_tpu.crush.types import (
+    CrushMap,
+    Bucket,
+    Rule,
+    Tunables,
+    ChooseArgs,
+    BucketAlg,
+    RuleOp,
+    ITEM_NONE,
+    ITEM_UNDEF,
+)
+from ceph_tpu.crush.mapper_ref import do_rule as do_rule_ref, find_rule

@@ -1,0 +1,334 @@
+"""CRUSH map object model — our own design, semantics-compatible with the C
+reference (reference src/crush/crush.h:229-461, src/crush/builder.c).
+
+A CrushMap is a hierarchy of weighted buckets (internal nodes, negative ids)
+over devices (leaves, ids >= 0), plus placement rules.  Weights are 16.16
+fixed point throughout (0x10000 == weight 1.0).
+
+This is the *host-side* model: mutable, Pythonic, used by builders, the text
+compiler and the CLIs.  The TPU kernels consume the frozen structure-of-arrays
+form built by ceph_tpu.crush.soa.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+ITEM_NONE = 0x7FFFFFFF  # CRUSH_ITEM_NONE  (reference src/crush/crush.h:33)
+ITEM_UNDEF = 0x7FFFFFFE  # CRUSH_ITEM_UNDEF (mapping in progress)
+MAX_DEPTH = 10  # CRUSH_MAX_DEPTH (reference src/crush/crush.h:26)
+
+
+class BucketAlg(IntEnum):
+    # reference src/crush/crush.h crush_algorithm
+    UNIFORM = 1
+    LIST = 2
+    TREE = 3
+    STRAW = 4
+    STRAW2 = 5
+
+
+class RuleOp(IntEnum):
+    # reference src/crush/crush.h:52-70 crush_opcodes
+    NOOP = 0
+    TAKE = 1
+    CHOOSE_FIRSTN = 2
+    CHOOSE_INDEP = 3
+    EMIT = 4
+    CHOOSELEAF_FIRSTN = 6
+    CHOOSELEAF_INDEP = 7
+    SET_CHOOSE_TRIES = 8
+    SET_CHOOSELEAF_TRIES = 9
+    SET_CHOOSE_LOCAL_TRIES = 10
+    SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+    SET_CHOOSELEAF_VARY_R = 12
+    SET_CHOOSELEAF_STABLE = 13
+
+
+@dataclass
+class Tunables:
+    """Mapping tunables; defaults = the modern "jewel" profile
+    (reference src/crush/CrushWrapper.h:331-368 set_tunables_jewel)."""
+
+    choose_local_tries: int = 0
+    choose_local_fallback_tries: int = 0
+    choose_total_tries: int = 50
+    chooseleaf_descend_once: int = 1
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+    straw_calc_version: int = 1
+    allowed_bucket_algs: int = (
+        (1 << BucketAlg.UNIFORM)
+        | (1 << BucketAlg.LIST)
+        | (1 << BucketAlg.STRAW)
+        | (1 << BucketAlg.STRAW2)
+    )
+
+    @classmethod
+    def profile(cls, name: str) -> "Tunables":
+        # reference src/crush/CrushWrapper.h:331-368 (set_tunables_*)
+        if name in ("legacy", "argonaut"):
+            return cls(2, 5, 19, 0, 0, 0, 0, 0xFFFFFFFF)
+        if name == "bobtail":
+            return cls(0, 0, 50, 1, 0, 0, 0, 0xFFFFFFFF)
+        if name in ("firefly", "hammer"):
+            t = cls(0, 0, 50, 1, 1, 0)
+            return t
+        if name in ("jewel", "default", "optimal"):
+            return cls()
+        raise ValueError(f"unknown tunables profile {name!r}")
+
+
+def _tree_height(n: int) -> int:
+    h = 0
+    while (n & 1) == 0:
+        h += 1
+        n >>= 1
+    return h
+
+
+def tree_node_of_leaf(i: int) -> int:
+    """leaf index -> tree node id (reference src/crush/crush.h:504-507)."""
+    return ((i + 1) << 1) - 1
+
+
+def tree_parent(n: int) -> int:
+    # reference src/crush/builder.c:305-311
+    h = _tree_height(n)
+    if n & (1 << (h + 1)):
+        return n - (1 << h)
+    return n + (1 << h)
+
+
+@dataclass
+class Bucket:
+    """One internal node.  items are child ids (devices >= 0, buckets < 0);
+    weights are per-child 16.16 fixed point."""
+
+    id: int
+    alg: BucketAlg
+    type: int
+    items: list[int] = field(default_factory=list)
+    weights: list[int] = field(default_factory=list)
+    hash: int = 0  # CRUSH_HASH_RJENKINS1
+    # alg-specific derived tables (built lazily by finalize_derived):
+    sum_weights: list[int] | None = None  # LIST: prefix sums
+    node_weights: list[int] | None = None  # TREE: heap-layout node weights
+    straws: list[int] | None = None  # STRAW: scaled straw lengths
+
+    @property
+    def size(self) -> int:
+        return len(self.items)
+
+    @property
+    def weight(self) -> int:
+        return sum(self.weights)
+
+    def finalize_derived(self, straw_calc_version: int = 1) -> None:
+        if self.alg == BucketAlg.LIST:
+            s, acc = [], 0
+            for w in self.weights:
+                acc += w
+                s.append(acc)
+            self.sum_weights = s
+        elif self.alg == BucketAlg.TREE:
+            # reference src/crush/builder.c:328-391 crush_make_tree_bucket
+            if self.size == 0:
+                self.node_weights = []
+                return
+            # calc_depth semantics (reference src/crush/builder.c:314-326)
+            t = self.size - 1
+            depth = 1
+            while t:
+                t >>= 1
+                depth += 1
+            num_nodes = 1 << depth
+            nw = [0] * num_nodes
+            for i, w in enumerate(self.weights):
+                node = tree_node_of_leaf(i)
+                nw[node] = w
+                for _ in range(1, depth):
+                    node = tree_parent(node)
+                    nw[node] += w
+            self.node_weights = nw
+        elif self.alg == BucketAlg.STRAW:
+            self.straws = calc_straws(self.weights, straw_calc_version)
+
+
+def calc_straws(weights: list[int], straw_calc_version: int = 1) -> list[int]:
+    """Legacy straw(1) scaler (reference src/crush/builder.c:431-545
+    crush_calc_straw).  Kept for parity with old maps; straw2 needs none."""
+    size = len(weights)
+    straws = [0] * size
+    # stable reverse argsort by weight, ties keep original order (insertion
+    # sort semantics of the reference)
+    reverse = sorted(range(size), key=lambda i: (weights[i], i))
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if straw_calc_version == 0:
+            if weights[reverse[i]] == 0:
+                straws[reverse[i]] = 0
+                i += 1
+                continue
+            straws[reverse[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            if weights[reverse[i]] == weights[reverse[i - 1]]:
+                continue
+            wbelow += (float(weights[reverse[i - 1]]) - lastw) * numleft
+            for j in range(i, size):
+                if weights[reverse[j]] == weights[reverse[i]]:
+                    numleft -= 1
+                else:
+                    break
+            wnext = numleft * (weights[reverse[i]] - weights[reverse[i - 1]])
+            pbelow = wbelow / (wbelow + wnext)
+            straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+            lastw = float(weights[reverse[i - 1]])
+        else:
+            if weights[reverse[i]] == 0:
+                straws[reverse[i]] = 0
+                i += 1
+                numleft -= 1
+                continue
+            straws[reverse[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            wbelow += (float(weights[reverse[i - 1]]) - lastw) * numleft
+            numleft -= 1
+            wnext = numleft * (weights[reverse[i]] - weights[reverse[i - 1]])
+            pbelow = wbelow / (wbelow + wnext)
+            straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+            lastw = float(weights[reverse[i - 1]])
+    return straws
+
+
+@dataclass
+class Rule:
+    """A placement rule: list of (op, arg1, arg2) steps plus its mask
+    (reference src/crush/crush.h crush_rule{,_mask,_step})."""
+
+    steps: list[tuple[int, int, int]]
+    ruleset: int = 0
+    type: int = 1  # pool type: 1=replicated, 3=erasure
+    min_size: int = 1
+    max_size: int = 10
+
+
+@dataclass
+class ChooseArgs:
+    """Per-bucket weight-set overrides (reference src/crush/crush.h:273-294
+    crush_choose_arg{,_map}).  weight_sets[bucket_id] is a [positions][size]
+    list of alternative 16.16 weights; ids[bucket_id] optionally remaps the
+    hashed item ids."""
+
+    weight_sets: dict[int, list[list[int]]] = field(default_factory=dict)
+    ids: dict[int, list[int]] = field(default_factory=dict)
+
+
+class CrushMap:
+    """The full map: buckets + rules + tunables + named choose_args."""
+
+    def __init__(self, tunables: Tunables | None = None):
+        self.tunables = tunables or Tunables()
+        self.buckets: dict[int, Bucket] = {}  # id (<0) -> Bucket
+        self.rules: list[Rule | None] = []
+        self.max_devices = 0
+        self.choose_args: dict[int | str, ChooseArgs] = {}
+        # naming layers (CrushWrapper equivalents)
+        self.type_names: dict[int, str] = {0: "osd"}
+        self.item_names: dict[int, str] = {}
+        self.item_classes: dict[int, str] = {}
+        self.class_bucket: dict[int, dict[int, int]] = {}  # orig id -> class id -> shadow id
+        self.choose_tries_histogram: list[int] | None = None
+
+    # -- construction ------------------------------------------------------
+    @property
+    def max_buckets(self) -> int:
+        return -min(self.buckets.keys(), default=0)
+
+    def next_bucket_id(self) -> int:
+        for i in range(len(self.buckets) + 1):
+            if -1 - i not in self.buckets:
+                return -1 - i
+        raise AssertionError
+
+    def add_bucket(
+        self,
+        alg: BucketAlg | int,
+        type_: int,
+        items: list[int],
+        weights: list[int],
+        id: int | None = None,
+        hash: int = 0,
+        name: str | None = None,
+    ) -> int:
+        bid = self.next_bucket_id() if id is None else id
+        assert bid < 0 and bid not in self.buckets
+        b = Bucket(bid, BucketAlg(alg), type_, list(items), list(weights), hash)
+        b.finalize_derived(self.tunables.straw_calc_version)
+        self.buckets[bid] = b
+        for it in items:
+            if it >= 0:
+                self.max_devices = max(self.max_devices, it + 1)
+        if name is not None:
+            self.item_names[bid] = name
+        return bid
+
+    def add_rule(self, rule: Rule, ruleno: int | None = None) -> int:
+        if ruleno is None:
+            self.rules.append(rule)
+            return len(self.rules) - 1
+        while len(self.rules) <= ruleno:
+            self.rules.append(None)
+        self.rules[ruleno] = rule
+        return ruleno
+
+    def bucket(self, item: int) -> Bucket | None:
+        return self.buckets.get(item)
+
+    def refresh_derived(self) -> None:
+        for b in self.buckets.values():
+            b.finalize_derived(self.tunables.straw_calc_version)
+
+    # -- convenience -------------------------------------------------------
+    def make_replicated_rule(
+        self, root: int, failure_domain_type: int, num_rep: int = 0
+    ) -> int:
+        """CrushWrapper::add_simple_rule semantics for a replicated pool
+        (reference src/crush/CrushWrapper.cc:2370): take root ->
+        chooseleaf_firstn {0|n} type fd -> emit."""
+        steps = [(RuleOp.TAKE, root, 0)]
+        if failure_domain_type == 0:
+            steps.append((RuleOp.CHOOSE_FIRSTN, num_rep, 0))
+        else:
+            steps.append((RuleOp.CHOOSELEAF_FIRSTN, num_rep, failure_domain_type))
+        steps.append((RuleOp.EMIT, 0, 0))
+        return self.add_rule(Rule(steps, ruleset=len(self.rules), type=1))
+
+    def make_erasure_rule(
+        self, root: int, failure_domain_type: int, num_chunks: int = 0
+    ) -> int:
+        """ErasureCode::create_rule semantics (reference
+        src/erasure-code/ErasureCode.cc:64-83): set_chooseleaf_tries 5 ->
+        take root -> chooseleaf_indep {0|n} type fd -> emit."""
+        steps = [
+            (RuleOp.SET_CHOOSELEAF_TRIES, 5, 0),
+            (RuleOp.TAKE, root, 0),
+        ]
+        if failure_domain_type == 0:
+            steps.append((RuleOp.CHOOSE_INDEP, num_chunks, 0))
+        else:
+            steps.append((RuleOp.CHOOSELEAF_INDEP, num_chunks, failure_domain_type))
+        steps.append((RuleOp.EMIT, 0, 0))
+        return self.add_rule(
+            Rule(steps, ruleset=len(self.rules), type=3, max_size=20)
+        )

@@ -1,0 +1,34 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh so sharding tests work
+without TPU hardware; the real-chip path is exercised by bench.py."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # for `oracle`
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def oracle_lib():
+    from oracle import load
+
+    lib = load()
+    if lib is None:
+        pytest.skip("reference C oracle unavailable (no mount or compiler)")
+    return lib
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xC3A5)

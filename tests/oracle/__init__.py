@@ -1,0 +1,146 @@
+"""ctypes loader for the C differential oracle (see shim.c).
+
+Compiles shim.c against the *read-only* reference CRUSH sources at first use
+(cached in tests/oracle/build/).  If the reference mount or a C compiler is
+unavailable, `load()` returns None and differential tests self-skip — the
+pure-Python reference mapper (ceph_tpu.crush.mapper_ref) remains the oracle
+for CI environments without the mount.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+REF = Path(os.environ.get("CEPH_REFERENCE", "/root/reference"))
+HERE = Path(__file__).resolve().parent
+SO = HERE / "build" / "liboracle.so"
+
+_SOURCES = ["mapper.c", "hash.c", "builder.c", "crush.c"]
+
+
+def build() -> Path | None:
+    crush_dir = REF / "src" / "crush"
+    if not crush_dir.is_dir():
+        return None
+    srcs = [str(crush_dir / s) for s in _SOURCES]
+    newest = max(os.path.getmtime(s) for s in srcs + [str(HERE / "shim.c")])
+    if SO.exists() and os.path.getmtime(SO) >= newest:
+        return SO
+    SO.parent.mkdir(parents=True, exist_ok=True)
+    # acconfig.h is normally cmake-generated in the reference build tree;
+    # an empty stub suffices on Linux (__u8 etc. come from linux/types.h).
+    (SO.parent / "acconfig.h").write_text("/* stub for oracle build */\n")
+    cmd = [
+        "cc", "-O2", "-g", "-fPIC", "-shared",
+        "-I", str(SO.parent),
+        "-I", str(crush_dir),
+        "-I", str(REF / "src"),
+        str(HERE / "shim.c"), *srcs,
+        "-o", str(SO), "-lm",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return None
+    return SO
+
+
+_lib = None
+
+
+def load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = build()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(str(so))
+    lib.oracle_map_create.restype = ctypes.c_void_p
+    lib.oracle_map_create.argtypes = [ctypes.c_int] * 6
+    lib.oracle_add_bucket.restype = ctypes.c_int
+    lib.oracle_add_bucket.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.oracle_add_rule.restype = ctypes.c_int
+    lib.oracle_add_rule.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.oracle_finalize.argtypes = [ctypes.c_void_p]
+    lib.oracle_do_rule.restype = ctypes.c_int
+    lib.oracle_do_rule.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint), ctypes.c_int,
+    ]
+    lib.oracle_set_choose_args.restype = ctypes.c_int
+    lib.oracle_set_choose_args.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint),
+    ]
+    lib.oracle_hash32_2.restype = ctypes.c_uint
+    lib.oracle_hash32_2.argtypes = [ctypes.c_uint, ctypes.c_uint]
+    lib.oracle_hash32_3.restype = ctypes.c_uint
+    lib.oracle_hash32_3.argtypes = [ctypes.c_uint] * 3
+    lib.oracle_map_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class OracleMap:
+    """Pythonic wrapper over the C oracle for building maps + running rules."""
+
+    def __init__(self, tunables=None):
+        from ceph_tpu.crush.types import Tunables
+
+        t = tunables or Tunables()
+        self.lib = load()
+        assert self.lib is not None
+        self.h = self.lib.oracle_map_create(
+            t.choose_local_tries, t.choose_local_fallback_tries,
+            t.choose_total_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable)
+
+    def add_bucket(self, alg, hash_, type_, items, weights):
+        n = len(items)
+        ia = (ctypes.c_int * n)(*[int(i) for i in items])
+        wa = (ctypes.c_int * n)(*[int(w) for w in weights])
+        bid = self.lib.oracle_add_bucket(self.h, alg, hash_, type_, n, ia, wa)
+        assert bid < 0, f"oracle_add_bucket failed: {bid}"
+        return bid
+
+    def add_rule(self, steps, ruleset=0, type_=1, minsize=1, maxsize=10):
+        n = len(steps)
+        ops = (ctypes.c_int * n)(*[s[0] for s in steps])
+        a1 = (ctypes.c_int * n)(*[s[1] for s in steps])
+        a2 = (ctypes.c_int * n)(*[s[2] for s in steps])
+        return self.lib.oracle_add_rule(self.h, ruleset, type_, minsize,
+                                        maxsize, n, ops, a1, a2)
+
+    def finalize(self):
+        self.lib.oracle_finalize(self.h)
+
+    def set_choose_args(self, positions, flat_weights):
+        n = len(flat_weights)
+        wa = (ctypes.c_uint * n)(*[int(w) for w in flat_weights])
+        self.lib.oracle_set_choose_args(self.h, positions, wa)
+
+    def do_rule(self, ruleno, x, weights, result_max):
+        res = (ctypes.c_int * result_max)()
+        wn = len(weights)
+        wa = (ctypes.c_uint * wn)(*[int(w) for w in weights])
+        n = self.lib.oracle_do_rule(self.h, ruleno, int(x) & 0xFFFFFFFF, res,
+                                    result_max, wa, wn)
+        return [res[i] for i in range(n)]
+
+    def __del__(self):
+        try:
+            self.lib.oracle_map_destroy(self.h)
+        except Exception:
+            pass

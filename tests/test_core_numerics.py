@@ -1,0 +1,111 @@
+"""Differential tests: rjenkins hash + crush_ln vs the compiled C reference,
+and numpy-vs-jax agreement of both."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core.rjenkins import (
+    crush_hash32,
+    crush_hash32_2,
+    crush_hash32_3,
+    crush_hash32_5,
+)
+from ceph_tpu.core.lntable import crush_ln_np, crush_ln_jax, RH_LH_TBL, LL_TBL
+from ceph_tpu.core.intmath import stable_mod, pg_mask_for
+
+
+def test_hash2_vs_c(oracle_lib, rng):
+    a = rng.integers(0, 2**32, 2000, dtype=np.uint32)
+    b = rng.integers(0, 2**32, 2000, dtype=np.uint32)
+    ours = crush_hash32_2(a, b)
+    for i in range(0, 2000, 97):
+        assert int(ours[i]) == oracle_lib.oracle_hash32_2(int(a[i]), int(b[i]))
+
+
+def test_hash3_vs_c(oracle_lib, rng):
+    a = rng.integers(0, 2**32, 500, dtype=np.uint32)
+    b = rng.integers(0, 2**32, 500, dtype=np.uint32)
+    c = rng.integers(0, 2**32, 500, dtype=np.uint32)
+    ours = crush_hash32_3(a, b, c)
+    for i in range(0, 500, 41):
+        assert int(ours[i]) == oracle_lib.oracle_hash32_3(
+            int(a[i]), int(b[i]), int(c[i])
+        )
+
+
+def test_hash_known_vectors():
+    # values pinned from the C-oracle-verified implementation, so the suite
+    # catches regressions even without the reference mount
+    from ceph_tpu.core.rjenkins import crush_hash32_4, str_hash_rjenkins
+
+    assert int(crush_hash32(0)) == 398764043
+    assert int(crush_hash32(12345)) == 3450610134
+    assert int(crush_hash32_2(0, 0)) == 430787817
+    assert int(crush_hash32_2(1234, 5678)) == 2437553297
+    assert int(crush_hash32_3(1, 2, 3)) == 1935332395
+    assert int(crush_hash32_4(1, 2, 3, 4)) == 1768759062
+    assert int(crush_hash32_5(1, 2, 3, 4, 5)) == 1262657953
+    assert str_hash_rjenkins(b"foo") == 2143417350
+    assert str_hash_rjenkins(b"") == 3175731469
+    assert str_hash_rjenkins(b"0123456789abcdef") == 3776469959
+
+
+def test_hash_jax_matches_numpy():
+    import jax.numpy as jnp
+
+    a = (np.arange(1000, dtype=np.uint64) * 2654435761 % (2**32)).astype(np.uint32)
+    b = (a * np.uint32(31) + np.uint32(7)).astype(np.uint32)
+    np_h = crush_hash32_2(a, b)
+    jx_h = np.asarray(crush_hash32_2(jnp.asarray(a), jnp.asarray(b), xp=jnp))
+    np.testing.assert_array_equal(np_h, jx_h)
+    np_h3 = crush_hash32_3(a, b, a ^ b)
+    jx_h3 = np.asarray(
+        crush_hash32_3(jnp.asarray(a), jnp.asarray(b), jnp.asarray(a ^ b), xp=jnp)
+    )
+    np.testing.assert_array_equal(np_h3, jx_h3)
+    np_h5 = crush_hash32_5(a, b, a, b, a)
+    jx_h5 = np.asarray(
+        crush_hash32_5(*(jnp.asarray(v) for v in (a, b, a, b, a)), xp=jnp)
+    )
+    np.testing.assert_array_equal(np_h5, jx_h5)
+
+
+def test_ln_tables_shapes():
+    assert RH_LH_TBL.shape == (258,)
+    assert LL_TBL.shape == (256,)
+    assert RH_LH_TBL[0] == 1 << 48
+    assert RH_LH_TBL[256] == 1 << 47
+    assert RH_LH_TBL[257] == 0xFFFF00000000
+
+
+def test_crush_ln_exhaustive_numpy_vs_jax():
+    import jax.numpy as jnp
+
+    x = np.arange(0x10000, dtype=np.uint32)
+    a = crush_ln_np(x)
+    b = np.asarray(crush_ln_jax(jnp.asarray(x)))
+    np.testing.assert_array_equal(a.astype(np.uint64), b.astype(np.uint64))
+
+
+def test_crush_ln_monotone_and_range():
+    x = np.arange(0x10000, dtype=np.uint32)
+    v = crush_ln_np(x).astype(np.int64)
+    # 2^44*log2(x+1): ln(0)=0, ln(0xffff)=almost 2^48
+    assert v[0] == 0
+    assert v[-1] <= 1 << 48
+    # monotone everywhere except the final step, where the reference's capped
+    # RH_LH_TBL[257]=0xffff00000000 entry (not 2^48) makes ln(0xffff) dip —
+    # a table quirk we reproduce bit-for-bit.
+    assert np.all(np.diff(v)[:-1] >= 0)
+    assert v[-1] < v[-2]
+
+
+def test_stable_mod():
+    # reference src/include/rados.h:96-102
+    for b in [1, 3, 8, 12, 100, 128, 1000]:
+        bmask = pg_mask_for(b)
+        for x in range(0, 4 * (bmask + 1), 7):
+            lo = x & bmask
+            want = lo if lo < b else x & (bmask >> 1)
+            assert int(stable_mod(x, b, bmask)) == want
+            assert int(stable_mod(x, b, bmask)) < b

@@ -1,0 +1,186 @@
+"""Differential tests: pure-Python reference mapper vs the compiled C
+reference crush_do_rule, over a grid of topologies / bucket algorithms /
+tunables / rules.  Exact element-wise equality is required."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.mapper_ref import do_rule
+from ceph_tpu.crush.types import BucketAlg, ChooseArgs, CrushMap, Rule, RuleOp, Tunables
+
+from util_maps import build_flat, build_tree, to_oracle, HOST, ROOT
+
+
+def compare(m, om, ruleno, weights, xs, result_max=3, choose_args=None):
+    for x in xs:
+        ours = do_rule(m, ruleno, int(x), result_max, weights, choose_args)
+        theirs = om.do_rule(ruleno, int(x), weights, result_max)
+        assert ours == theirs, (
+            f"x={x} rule={ruleno} ours={ours} theirs={theirs}"
+        )
+
+
+XS = list(range(64)) + [12345, 999999, 2**31 - 1, 2**32 - 5]
+
+
+@pytest.mark.parametrize("alg", [BucketAlg.STRAW2, BucketAlg.STRAW,
+                                 BucketAlg.LIST, BucketAlg.TREE,
+                                 BucketAlg.UNIFORM])
+def test_flat_firstn(oracle_lib, alg):
+    m, root = build_flat(17, alg)
+    r = m.make_replicated_rule(root, 0)
+    om = to_oracle(m)
+    compare(m, om, r, [0x10000] * 17, XS, result_max=3)
+
+
+@pytest.mark.parametrize("alg", [BucketAlg.STRAW2, BucketAlg.LIST,
+                                 BucketAlg.TREE, BucketAlg.UNIFORM])
+def test_flat_indep(oracle_lib, alg):
+    m, root = build_flat(10, alg)
+    m.add_rule(Rule([(RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_INDEP, 0, 0),
+                     (RuleOp.EMIT, 0, 0)], type=3))
+    om = to_oracle(m)
+    compare(m, om, 0, [0x10000] * 10, XS, result_max=4)
+
+
+def test_flat_weighted_straw2(oracle_lib, rng):
+    n = 25
+    weights = [int(w) for w in rng.integers(1, 8 * 0x10000, n)]
+    weights[3] = 0  # a zero-weight item
+    m = CrushMap()
+    root = m.add_bucket(BucketAlg.STRAW2, ROOT, list(range(n)), weights)
+    r = m.make_replicated_rule(root, 0)
+    om = to_oracle(m)
+    compare(m, om, r, [0x10000] * n, XS)
+
+
+def test_flat_reweighted_devices(oracle_lib, rng):
+    """device in/out probability vector != crush weights"""
+    n = 20
+    m, root = build_flat(n)
+    r = m.make_replicated_rule(root, 0)
+    om = to_oracle(m)
+    dev_w = [int(w) for w in rng.integers(0, 0x10000 + 1, n)]
+    dev_w[0] = 0
+    dev_w[1] = 0x10000
+    dev_w[2] = 0x8000
+    compare(m, om, r, dev_w, XS)
+
+
+@pytest.mark.parametrize("host_alg", [BucketAlg.STRAW2, BucketAlg.LIST,
+                                      BucketAlg.TREE, BucketAlg.UNIFORM,
+                                      BucketAlg.STRAW])
+def test_chooseleaf_firstn(oracle_lib, rng, host_alg):
+    m, root = build_tree(rng, n_host=6, osd_per_host=4, host_alg=host_alg,
+                         weight_fn=lambda i: 0x10000 + (i % 5) * 0x4000)
+    r = m.make_replicated_rule(root, HOST)
+    om = to_oracle(m)
+    compare(m, om, r, [0x10000] * 24, XS)
+
+
+def test_chooseleaf_indep_ec(oracle_lib, rng):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3)
+    r = m.make_erasure_rule(root, HOST)
+    om = to_oracle(m)
+    compare(m, om, r, [0x10000] * 24, XS, result_max=6)
+
+
+def test_choose_then_chooseleaf(oracle_lib, rng):
+    """multi-step rule: choose 2 racks, then chooseleaf 2 hosts under each."""
+    m, root = build_tree(rng, n_host=8, osd_per_host=3, n_rack=4)
+    m.add_rule(Rule([(RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_FIRSTN, 2, 2),  # 2 racks
+                     (RuleOp.CHOOSELEAF_FIRSTN, 2, HOST),
+                     (RuleOp.EMIT, 0, 0)]))
+    om = to_oracle(m)
+    compare(m, om, 0, [0x10000] * 24, XS, result_max=4)
+
+
+@pytest.mark.parametrize("profile", ["legacy", "bobtail", "firefly", "jewel"])
+def test_tunables_profiles(oracle_lib, rng, profile):
+    t = Tunables.profile(profile)
+    m, root = build_tree(rng, n_host=5, osd_per_host=4, tunables=t,
+                         weight_fn=lambda i: 0x10000 * (1 + i % 3))
+    r = m.make_replicated_rule(root, HOST)
+    om = to_oracle(m)
+    # also mark some devices partially/fully out to exercise retries
+    w = [0x10000] * 20
+    w[2] = 0
+    w[7] = 0x4000
+    w[11] = 0
+    compare(m, om, r, w, XS)
+
+
+def test_degenerate_small_hierarchy(oracle_lib, rng):
+    """numrep > devices available under constraint -> skip_rep/NONE paths"""
+    m, root = build_tree(rng, n_host=3, osd_per_host=2)
+    rr = m.make_replicated_rule(root, HOST)  # only 3 hosts for numrep=3
+    re_ = m.make_erasure_rule(root, HOST)
+    om = to_oracle(m)
+    compare(m, om, rr, [0x10000] * 6, XS, result_max=3)
+    compare(m, om, re_, [0x10000] * 6, XS, result_max=5)
+
+
+def test_set_tries_steps(oracle_lib, rng):
+    m, root = build_tree(rng, n_host=6, osd_per_host=4)
+    m.add_rule(Rule([
+        (RuleOp.SET_CHOOSE_TRIES, 100, 0),
+        (RuleOp.SET_CHOOSELEAF_TRIES, 7, 0),
+        (RuleOp.SET_CHOOSELEAF_VARY_R, 0, 0),
+        (RuleOp.SET_CHOOSELEAF_STABLE, 0, 0),
+        (RuleOp.TAKE, root, 0),
+        (RuleOp.CHOOSELEAF_FIRSTN, 0, HOST),
+        (RuleOp.EMIT, 0, 0)]))
+    om = to_oracle(m)
+    w = [0x10000] * 24
+    w[5] = 0
+    compare(m, om, 0, w, XS)
+
+
+def test_choose_args_weight_set(oracle_lib, rng):
+    """choose_args per-position weight overrides (straw2 only)."""
+    m, root = build_tree(rng, n_host=4, osd_per_host=4)
+    r = m.make_replicated_rule(root, HOST)
+    om = to_oracle(m)
+    positions = 3
+    ca = ChooseArgs()
+    flat = []
+    # oracle_set_choose_args consumes weights bucket-slot-major (b=0 => id -1)
+    for slot in range(m.max_buckets):
+        bid = -1 - slot
+        b = m.buckets[bid]
+        ws = []
+        for pos in range(positions):
+            row = [int(w) for w in rng.integers(1, 4 * 0x10000, b.size)]
+            ws.append(row)
+            flat.extend(row)
+        ca.weight_sets[bid] = ws
+    om.set_choose_args(positions, flat)
+    compare(m, om, r, [0x10000] * 16, XS, choose_args=ca)
+
+
+def test_zero_size_take_of_device(oracle_lib):
+    """rule that takes a device directly, and an emit of it"""
+    m, root = build_flat(4)
+    m.add_rule(Rule([(RuleOp.TAKE, 2, 0), (RuleOp.EMIT, 0, 0)]))
+    om = to_oracle(m)
+    compare(m, om, 0, [0x10000] * 4, XS, result_max=3)
+
+
+def test_big_random_grid(oracle_lib, rng):
+    """randomized topologies & mixed algs, moderate x sweep"""
+    algs = [BucketAlg.STRAW2, BucketAlg.LIST, BucketAlg.TREE,
+            BucketAlg.UNIFORM, BucketAlg.STRAW]
+    for trial in range(6):
+        host_alg = algs[trial % len(algs)]
+        n_host = int(rng.integers(2, 9))
+        per = int(rng.integers(1, 6))
+        m, root = build_tree(
+            rng, n_host=n_host, osd_per_host=per, host_alg=host_alg,
+            weight_fn=lambda i: int(rng.integers(1, 3 * 0x10000)))
+        rr = m.make_replicated_rule(root, HOST)
+        om = to_oracle(m)
+        n = n_host * per
+        w = [int(v) for v in rng.integers(0, 0x10001, n)]
+        compare(m, om, rr, w, range(100), result_max=3)

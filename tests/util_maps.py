@@ -1,0 +1,100 @@
+"""Shared test-map construction: builds identical maps in our Python model and
+(optionally) in the compiled C oracle, so outputs can be compared bit-exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.crush.types import BucketAlg, CrushMap, Rule, RuleOp, Tunables
+
+HOST = 1
+RACK = 2
+ROOT = 3
+
+
+def build_flat(n_osd=32, alg=BucketAlg.STRAW2, weights=None, tunables=None):
+    """One root bucket holding n_osd devices."""
+    m = CrushMap(tunables)
+    if weights is None:
+        weights = [0x10000] * n_osd
+    root = m.add_bucket(alg, ROOT, list(range(n_osd)), weights, name="root")
+    return m, root
+
+
+def build_tree(
+    rng: np.random.Generator,
+    n_host=8,
+    osd_per_host=4,
+    host_alg=BucketAlg.STRAW2,
+    root_alg=BucketAlg.STRAW2,
+    weight_fn=None,
+    tunables=None,
+    n_rack=0,
+):
+    """hosts of osds under (optional racks under) one root.  weight_fn(osd_id)
+    gives the 16.16 device weight (uniform buckets force equal weights)."""
+    m = CrushMap(tunables)
+    host_ids = []
+    osd = 0
+    for h in range(n_host):
+        items = list(range(osd, osd + osd_per_host))
+        if weight_fn is None or host_alg == BucketAlg.UNIFORM:
+            ws = [0x10000] * osd_per_host
+        else:
+            ws = [int(weight_fn(i)) for i in items]
+        hid = m.add_bucket(host_alg, HOST, items, ws, name=f"host{h}")
+        host_ids.append((hid, sum(ws)))
+        osd += osd_per_host
+    if n_rack:
+        per = max(1, n_host // n_rack)
+        rack_ids = []
+        for r in range(n_rack):
+            hs = host_ids[r * per : (r + 1) * per] or [host_ids[-1]]
+            rid = m.add_bucket(
+                BucketAlg.STRAW2,
+                RACK,
+                [h for h, _ in hs],
+                [w for _, w in hs],
+                name=f"rack{r}",
+            )
+            rack_ids.append((rid, sum(w for _, w in hs)))
+        top = rack_ids
+    else:
+        top = host_ids
+    root = m.add_bucket(
+        root_alg, ROOT, [b for b, _ in top], [w for b, w in top], name="root"
+    )
+    return m, root
+
+
+def to_oracle(m: CrushMap, tunables: Tunables | None = None):
+    """Mirror a CrushMap into the C oracle (same construction order =>
+    same bucket ids).  Returns the OracleMap."""
+    from oracle import OracleMap
+
+    om = OracleMap(tunables or m.tunables)
+    # insert in id order -1, -2, ... to reproduce sequential id assignment
+    for bid in sorted(m.buckets.keys(), reverse=True):
+        b = m.buckets[bid]
+        got = om.add_bucket(int(b.alg), b.hash, b.type, b.items, b.weights)
+        assert got == bid, (got, bid)
+    for r in m.rules:
+        assert r is not None
+        om.add_rule(
+            [(int(op), a1, a2) for op, a1, a2 in r.steps],
+            ruleset=r.ruleset,
+            type_=r.type,
+            minsize=r.min_size,
+            maxsize=r.max_size,
+        )
+    om.finalize()
+    return om
+
+
+def replicated_rule(m: CrushMap, root: int, fd_type=0, numrep=0):
+    return m.make_replicated_rule(root, fd_type, numrep)
+
+
+def ec_rule(m: CrushMap, root: int, fd_type=0, k_m=0):
+    return m.make_erasure_rule(root, fd_type, k_m)
