@@ -1,0 +1,730 @@
+"""Vmapped CRUSH mapper — the TPU hot path.
+
+Design (TPU-first, not a port):
+
+- The rule's step list is *static* per map, so `compile_rule` unrolls the
+  rule interpreter (reference src/crush/mapper.c:900-1105 crush_do_rule) at
+  trace time: each TAKE/CHOOSE/EMIT becomes straight-line traced code; the
+  SET_* steps fold into static Python ints.  There is no device-side
+  interpreter — XLA sees one fused integer program per (map, rule).
+- Each bucket draw is a masked lane operation over the padded item axis
+  (straw2 = hash + table-log + s64 divide + argmax over [S] lanes,
+  reference src/crush/mapper.c:361-384), so a single PG's mapping is a few
+  hundred VPU lane-ops and the PG axis vmaps cleanly to millions.
+- Data-dependent retry loops (reject/collision, reference
+  src/crush/mapper.c:460-648) become `lax.while_loop`s whose trip counts are
+  bounded by the map's choose_total_tries tunable; descents through the
+  hierarchy are `lax.fori_loop`s bounded by the map's static depth.
+
+Bit-exactness: same rjenkins hash, same fixed-point log tables, same s64
+truncating divide, same first-max argmax tie-breaking as the C reference.
+Differentially tested against ceph_tpu.crush.mapper_ref (itself tested
+against the compiled C) in tests/test_mapper_jax.py.
+
+Restrictions (asserted): the legacy tunables choose_local_tries /
+choose_local_fallback_tries must be 0 (their localized-retry semantics —
+reference src/crush/mapper.c:610-616 — are pre-2014 compat paths that no
+modern map uses; the host mapper_ref still supports them).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ceph_tpu.core.lntable import crush_ln_jax
+from ceph_tpu.core.rjenkins import crush_hash32_2, crush_hash32_3, crush_hash32_4
+from ceph_tpu.crush.soa import CrushArrays
+from ceph_tpu.crush.types import BucketAlg, ITEM_NONE, RuleOp
+
+S64_MIN = jnp.int64(-(2**63))
+
+# descent status codes
+_DESCENDING = 0
+_FOUND = 1
+_SKIP = 2
+_EMPTY = 3
+
+
+def _u32(v):
+    return jnp.asarray(v).astype(jnp.uint32)
+
+
+def _h2(a, b):
+    return crush_hash32_2(_u32(a), _u32(b), xp=jnp)
+
+
+def _h3(a, b, c):
+    return crush_hash32_3(_u32(a), _u32(b), _u32(c), xp=jnp)
+
+
+def _h4(a, b, c, d):
+    return crush_hash32_4(_u32(a), _u32(b), _u32(c), _u32(d), xp=jnp)
+
+
+class _DeviceArrays:
+    """jnp-device mirror of CrushArrays' tensors."""
+
+    def __init__(self, A: CrushArrays):
+        self.A = A
+        for f in (
+            "alg",
+            "btype",
+            "size",
+            "items",
+            "weights",
+            "sum_weights",
+            "straws",
+            "node_weights",
+            "num_nodes",
+            "pos_weights",
+            "arg_ids",
+        ):
+            setattr(self, f, jnp.asarray(getattr(A, f)))
+
+
+def _straw2_choose(d: _DeviceArrays, slot, x, r, position):
+    """reference src/crush/mapper.c:361-384 + 334-359."""
+    A = d.A
+    pos = jnp.clip(position, 0, A.positions - 1)
+    w = d.pos_weights[pos, slot].astype(jnp.int64)  # [S]
+    ids = d.arg_ids[slot]
+    lane = jnp.arange(A.max_size)
+    mask = lane < d.size[slot]
+    u = (_h3(x, ids, r) & 0xFFFF).astype(jnp.uint32)
+    ln = crush_ln_jax(u).astype(jnp.int64) - jnp.int64(0x1000000000000)
+    draw = lax.div(ln, jnp.maximum(w, 1))
+    draw = jnp.where((w > 0) & mask, draw, S64_MIN)
+    return d.items[slot, jnp.argmax(draw)]
+
+
+def _straw_choose(d: _DeviceArrays, slot, x, r):
+    """reference src/crush/mapper.c:227-245."""
+    A = d.A
+    lane = jnp.arange(A.max_size)
+    mask = lane < d.size[slot]
+    draw = (_h3(x, d.items[slot], r) & 0xFFFF).astype(jnp.uint64) * d.straws[
+        slot
+    ].astype(jnp.uint64)
+    draw = jnp.where(mask, draw, 0)
+    return d.items[slot, jnp.argmax(draw)]
+
+
+def _list_choose(d: _DeviceArrays, slot, x, r):
+    """reference src/crush/mapper.c:141-164 (scan from tail; first hit from
+    the high end == max index whose scaled hash falls inside its weight)."""
+    A = d.A
+    bid = -1 - slot
+    lane = jnp.arange(A.max_size)
+    w = (_h4(x, d.items[slot], r, bid) & 0xFFFF).astype(jnp.uint64)
+    w = (w * d.sum_weights[slot].astype(jnp.uint64)) >> 16
+    ok = (w < d.weights[slot].astype(jnp.uint64)) & (lane < d.size[slot])
+    best = jnp.max(jnp.where(ok, lane, -1))
+    return jnp.where(best >= 0, d.items[slot, jnp.maximum(best, 0)], d.items[slot, 0])
+
+
+def _ctz(n):
+    h = jnp.zeros_like(n)
+    m = n
+    for s in (16, 8, 4, 2, 1):
+        z = (m & ((1 << s) - 1)) == 0
+        h = jnp.where(z, h + s, h)
+        m = jnp.where(z, m >> s, m)
+    return h
+
+
+def _tree_choose(d: _DeviceArrays, slot, x, r):
+    """reference src/crush/mapper.c:195-222."""
+    bid = -1 - slot
+
+    def cond(n):
+        return (n & 1) == 0
+
+    def body(n):
+        w = d.node_weights[slot, n].astype(jnp.uint64)
+        t = (_h4(x, n, r, bid).astype(jnp.uint64) * w) >> 32
+        h = _ctz(n)
+        left = n - (1 << (h - 1))
+        return jnp.where(
+            t < d.node_weights[slot, left].astype(jnp.uint64),
+            left,
+            n + (1 << (h - 1)),
+        )
+
+    n = lax.while_loop(cond, body, d.num_nodes[slot] >> 1)
+    return d.items[slot, n >> 1]
+
+
+def _perm_choose(d: _DeviceArrays, slot, x, r):
+    """Uniform buckets (reference src/crush/mapper.c:73-138).  The C keeps
+    memoized Fisher-Yates state per bucket; the permutation is a pure
+    function of (x, bucket) — the r=0 fast path + lazy continuation produce
+    exactly the full Fisher-Yates shuffle — so we compute it statelessly."""
+    A = d.A
+    bid = -1 - slot
+    n = jnp.maximum(d.size[slot], 1)
+    pr = jnp.astype(r, jnp.uint32) % jnp.astype(n, jnp.uint32)
+
+    def body(p, perm):
+        i = jnp.astype(_h3(x, bid, p), jnp.uint32) % jnp.astype(
+            jnp.maximum(n - p, 1), jnp.uint32
+        )
+        do = p < n - 1
+        pi = jnp.where(do, p + i.astype(jnp.int32), p)
+        a = perm[p]
+        b = perm[pi]
+        perm = perm.at[p].set(jnp.where(do, b, a))
+        perm = perm.at[pi].set(jnp.where(do, a, b))
+        return perm
+
+    perm = lax.fori_loop(
+        0, max(A.max_size - 1, 0), body,
+        jnp.arange(A.max_size, dtype=jnp.int32),
+    )
+    return d.items[slot, perm[pr.astype(jnp.int32)]]
+
+
+def _bucket_choose(d: _DeviceArrays, slot, x, r, position):
+    """Dispatch on bucket alg (reference src/crush/mapper.c:387-418).  Only
+    algorithms present in the map are traced."""
+    A = d.A
+    present = sorted(set(int(a) for a in np.asarray(A.alg)) - {0})
+    branches = {
+        int(BucketAlg.UNIFORM): lambda: _perm_choose(d, slot, x, r),
+        int(BucketAlg.LIST): lambda: _list_choose(d, slot, x, r),
+        int(BucketAlg.TREE): lambda: _tree_choose(d, slot, x, r),
+        int(BucketAlg.STRAW): lambda: _straw_choose(d, slot, x, r),
+        int(BucketAlg.STRAW2): lambda: _straw2_choose(d, slot, x, r, position),
+    }
+    present = [p for p in present if p in branches]
+    if len(present) == 1:
+        return branches[present[0]]()
+    fns = [branches[p] for p in present]
+    idx = jnp.searchsorted(jnp.asarray(present), d.alg[slot])
+    return lax.switch(jnp.clip(idx, 0, len(fns) - 1), fns)
+
+
+def _is_out(x, item, dev_weights, weight_max):
+    """reference src/crush/mapper.c:424-438."""
+    w = dev_weights[jnp.clip(item, 0, weight_max - 1)].astype(jnp.uint32)
+    oor = item >= weight_max
+    frac_out = (_h2(x, item) & 0xFFFF) >= w
+    return oor | ((w < 0x10000) & ((w == 0) | frac_out))
+
+
+def _descend_impl(
+    d: _DeviceArrays, x, start_item, position, target_type: int, r_of_slot
+):
+    """Walk intervening buckets until an item of target_type emerges
+    (the retry_bucket descent of reference src/crush/mapper.c:507-555 /
+    710-771).  r_of_slot(slot) yields the replica draw for the current
+    bucket — constant for firstn, per-level stride-adjusted for indep
+    (reference src/crush/mapper.c:722-728).  Returns (item, status)."""
+    A = d.A
+
+    status0 = jnp.where(
+        (start_item < 0) & (-1 - start_item < A.n_buckets),
+        jnp.int32(_DESCENDING),
+        jnp.int32(_SKIP),
+    )
+
+    def body(_, st):
+        item, status, r_last = st
+        slot = jnp.clip(-1 - item, 0, A.n_buckets - 1)
+        empty = d.size[slot] == 0
+        r_cur = r_of_slot(slot)
+        nxt = _bucket_choose(d, slot, x, r_cur, position)
+        bad = nxt >= A.max_devices
+        is_b = nxt < 0
+        dangling = is_b & (-1 - nxt >= A.n_buckets)
+        nslot = jnp.clip(-1 - nxt, 0, A.n_buckets - 1)
+        ntype = jnp.where(is_b, d.btype[nslot], 0)
+        new_status = jnp.where(
+            empty,
+            jnp.int32(_EMPTY),
+            jnp.where(
+                bad | dangling,
+                jnp.int32(_SKIP),
+                jnp.where(
+                    ntype == target_type,
+                    jnp.int32(_FOUND),
+                    jnp.where(~is_b, jnp.int32(_SKIP), jnp.int32(_DESCENDING)),
+                ),
+            ),
+        )
+        active = status == _DESCENDING
+        return (
+            jnp.where(active & ~empty, nxt, item),
+            jnp.where(active, new_status, status),
+            jnp.where(active, r_cur, r_last).astype(jnp.int32),
+        )
+
+    item, status, r_last = lax.fori_loop(
+        0, A.max_depth + 1, body, (start_item, status0, jnp.int32(0))
+    )
+    # still descending after depth bound => treat as skip (cyclic/deep map)
+    status = jnp.where(status == _DESCENDING, jnp.int32(_SKIP), status)
+    return item, status, r_last
+
+
+def _descend(d: _DeviceArrays, x, start_item, r, position, target_type: int):
+    """firstn-style descent: one r for the whole walk."""
+    item, status, _ = _descend_impl(
+        d, x, start_item, position, target_type, lambda _: r
+    )
+    return item, status
+
+
+def _descend_indep(
+    d: _DeviceArrays, x, start_item, rep_base, ftotal, numrep: int,
+    position, target_type: int,
+):
+    """indep-style descent: r is re-derived at every level from the current
+    bucket — uniform buckets whose size divides numrep use stride numrep+1
+    (reference src/crush/mapper.c:719-728)."""
+
+    def r_of_slot(slot):
+        uni = (d.alg[slot] == int(BucketAlg.UNIFORM)) & (
+            d.size[slot] % numrep == 0
+        )
+        return (rep_base + jnp.where(uni, numrep + 1, numrep) * ftotal).astype(
+            jnp.int32
+        )
+
+    return _descend_impl(d, x, start_item, position, target_type, r_of_slot)
+
+
+def _collides(out, outpos, item, lo=0):
+    lane = jnp.arange(out.shape[0])
+    return jnp.any((lane >= lo) & (lane < outpos) & (out == item))
+
+
+def _leaf_firstn(
+    d: _DeviceArrays,
+    x,
+    item,
+    sub_r,
+    outpos,
+    out2,
+    dev_weights,
+    weight_max,
+    recurse_tries: int,
+    stable: int,
+):
+    """The recursive chooseleaf descent (reference src/crush/mapper.c:573-588):
+    pick ONE device under `item`, retrying up to recurse_tries, colliding
+    against out2[:outpos].  Returns (leaf, ok)."""
+    rep = jnp.where(jnp.bool_(stable), 0, outpos)
+
+    def cond(st):
+        ftotal, leaf, ok, dead = st
+        return (~ok) & (~dead) & (ftotal < recurse_tries)
+
+    def body(st):
+        ftotal, leaf, ok, dead = st
+        r = rep + sub_r + ftotal
+        cand, status = _descend(d, x, item, r, outpos, 0)
+        collide = _collides(out2, outpos, cand)
+        reject = _is_out(x, cand, dev_weights, weight_max)
+        good = (status == _FOUND) & ~collide & ~reject
+        # _SKIP is C's skip_rep inside the recursion: the single rep is
+        # abandoned (no further tries) and the call returns <= outpos.
+        return (
+            ftotal + 1,
+            jnp.where(good, cand, leaf),
+            ok | good,
+            dead | (status == _SKIP),
+        )
+
+    _, leaf, ok, _ = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int32(ITEM_NONE), jnp.bool_(False),
+         jnp.bool_(False)),
+    )
+    return leaf, ok
+
+
+def _choose_firstn_one(
+    d: _DeviceArrays,
+    x,
+    src,
+    count,
+    dev_weights,
+    *,
+    numrep: int,
+    target_type: int,
+    recurse_to_leaf: bool,
+    tries: int,
+    recurse_tries: int,
+    vary_r: int,
+    stable: int,
+    weight_max: int,
+    out_bound: int,
+):
+    """crush_choose_firstn for one source bucket, outpos starting at 0
+    (reference src/crush/mapper.c:460-648; modern tunables: no local
+    retries).  The rep loop runs the full numrep (a skipped rep is
+    compensated by later rep values, as in C); out_bound just sizes the
+    output arrays.  Returns (out[out_bound], out2[out_bound], n_placed)."""
+    NR = out_bound
+    out = jnp.full(NR, ITEM_NONE, jnp.int32)
+    out2 = jnp.full(NR, ITEM_NONE, jnp.int32)
+
+    def rep_body(rep, st):
+        outpos, out, out2, cnt = st
+
+        def attempt_cond(ast):
+            ftotal, item, leaf, placed, skip = ast
+            return (~placed) & (~skip)
+
+        def attempt_body(ast):
+            ftotal, item, leaf, placed, skip = ast
+            r = rep + ftotal
+            cand, status = _descend(d, x, src, r, outpos, target_type)
+            collide = _collides(out, outpos, cand)
+            if recurse_to_leaf:
+                sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
+                lf, lok = _leaf_firstn(
+                    d, x, cand, sub_r, outpos, out2, dev_weights,
+                    weight_max, recurse_tries, stable,
+                )
+                if target_type == 0:
+                    # degenerate chooseleaf to device type: item already leaf
+                    dev = cand >= 0
+                    lf = jnp.where(dev, cand, lf)
+                    lok = jnp.where(dev, jnp.bool_(True), lok)
+                    rj = jnp.where(
+                        dev,
+                        _is_out(x, cand, dev_weights, weight_max),
+                        ~lok,
+                    )
+                else:
+                    rj = ~lok
+                reject = jnp.where(collide, jnp.bool_(False), rj)
+            else:
+                lf = cand
+                if target_type == 0:
+                    reject = _is_out(x, cand, dev_weights, weight_max)
+                else:
+                    reject = jnp.bool_(False)
+
+            found = status == _FOUND
+            fail = (~found) | reject | collide
+            # status _SKIP => skip_rep immediately; _EMPTY counts as a try
+            hard_skip = status == _SKIP
+            ftotal2 = ftotal + jnp.where(fail & ~hard_skip, 1, 0)
+            exhausted = ftotal2 >= tries
+            return (
+                ftotal2,
+                jnp.where(found & ~fail, cand, item),
+                jnp.where(found & ~fail, lf, leaf),
+                found & ~fail,
+                hard_skip | (fail & ~hard_skip & exhausted),
+            )
+
+        ftotal0 = (
+            jnp.int32(0),
+            jnp.int32(ITEM_NONE),
+            jnp.int32(ITEM_NONE),
+            jnp.bool_(False),
+            jnp.bool_(False),
+        )
+        active = cnt > 0
+        ftotal, item, leaf, placed, skip = lax.while_loop(
+            attempt_cond, attempt_body, ftotal0
+        )
+        ok = active & placed
+        safe_pos = jnp.clip(outpos, 0, NR - 1)
+        out = out.at[safe_pos].set(jnp.where(ok, item, out[safe_pos]))
+        out2 = out2.at[safe_pos].set(jnp.where(ok, leaf, out2[safe_pos]))
+        return (
+            outpos + jnp.where(ok, 1, 0),
+            out,
+            out2,
+            cnt - jnp.where(ok, 1, 0),
+        )
+
+    outpos, out, out2, _ = lax.fori_loop(
+        0, numrep, rep_body, (jnp.int32(0), out, out2, jnp.int32(count))
+    )
+    return out, out2, outpos
+
+
+def _leaf_indep(
+    d: _DeviceArrays,
+    x,
+    item,
+    parent_r,
+    rep,
+    numrep: int,
+    recurse_tries: int,
+    dev_weights,
+    weight_max: int,
+):
+    """Recursive indep leaf pick (reference src/crush/mapper.c:784-798):
+    left=1, out slot `rep`, parent_r = outer r.  Returns (leaf, ok)."""
+
+    def cond(st):
+        ftotal, leaf, ok, dead = st
+        return (~ok) & (~dead) & (ftotal < recurse_tries)
+
+    def body(st):
+        ftotal, leaf, ok, dead = st
+        cand, status, _ = _descend_indep(
+            d, x, item, rep + parent_r, ftotal, numrep, rep, 0
+        )
+        reject = _is_out(x, cand, dev_weights, weight_max)
+        good = (status == _FOUND) & ~reject
+        # _SKIP writes NONE into the slot in C (left--), ending the attempt
+        return (
+            ftotal + 1,
+            jnp.where(good, cand, leaf),
+            ok | good,
+            dead | (status == _SKIP),
+        )
+
+    _, leaf, ok, _ = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int32(ITEM_NONE), jnp.bool_(False),
+         jnp.bool_(False)),
+    )
+    return leaf, ok
+
+
+def _choose_indep_one(
+    d: _DeviceArrays,
+    x,
+    src,
+    out_size,
+    dev_weights,
+    *,
+    numrep: int,
+    target_type: int,
+    recurse_to_leaf: bool,
+    tries: int,
+    recurse_tries: int,
+    weight_max: int,
+    out_bound: int,
+):
+    """crush_choose_indep for one source bucket (reference
+    src/crush/mapper.c:655-843): breadth-first, positionally stable, NONE
+    fills.  out_size (traced) <= out_bound (static array bound); numrep is
+    the rule's full choose count, which sets the retry r-stride and the
+    uniform-divisibility check per descent level (_descend_indep re-derives
+    r at every level exactly as reference src/crush/mapper.c:719-728 does).
+    """
+    NR = out_bound
+    UNDEF = jnp.int32(-0x7FFFFFFE)  # internal marker (distinct from NONE)
+    out = jnp.where(jnp.arange(NR) < out_size, UNDEF, jnp.int32(ITEM_NONE))
+    out2 = out
+
+    def round_body(st):
+        ftotal, left, out, out2 = st
+
+        def rep_body(rep, st2):
+            out, out2, left = st2
+            todo = (rep < out_size) & (out[rep] == UNDEF)
+            # choose_args position is the *call-level* outpos (0 here), not
+            # the replica slot (reference src/crush/mapper.c:736-740)
+            cand, status, r_last = _descend_indep(
+                d, x, src, rep, ftotal, numrep, 0, target_type
+            )
+            # the leaf recursion's parent_r is the full r of the level where
+            # the walk found the item (reference src/crush/mapper.c:794)
+            r_leaf = r_last
+            collide = jnp.any(
+                jnp.where(jnp.arange(NR) < out_size, out, ITEM_NONE) == cand
+            ) & (status == _FOUND)
+            if recurse_to_leaf:
+                lf, lok = _leaf_indep(
+                    d, x, cand, r_leaf, rep, numrep, recurse_tries,
+                    dev_weights, weight_max,
+                )
+                dev = cand >= 0
+                if target_type == 0:
+                    lf = jnp.where(dev, cand, lf)
+                    lok = jnp.where(dev, jnp.bool_(True), lok)
+                leaf_fail = ~lok
+            else:
+                lf = cand
+                leaf_fail = jnp.bool_(False)
+            if target_type == 0:
+                reject = _is_out(x, cand, dev_weights, weight_max)
+            else:
+                reject = jnp.bool_(False)
+            hard = status == _SKIP  # bad item => NONE + left--
+            good = (
+                (status == _FOUND) & ~collide & ~leaf_fail & ~reject
+            )
+            newv = jnp.where(
+                hard, jnp.int32(ITEM_NONE), jnp.where(good, cand, UNDEF)
+            )
+            newl = jnp.where(
+                hard, jnp.int32(ITEM_NONE), jnp.where(good, lf, UNDEF)
+            )
+            out = out.at[rep].set(jnp.where(todo, newv, out[rep]))
+            out2 = out2.at[rep].set(jnp.where(todo, newl, out2[rep]))
+            left = left - jnp.where(todo & (hard | good), 1, 0)
+            return out, out2, left
+
+        out, out2, left = lax.fori_loop(0, NR, rep_body, (out, out2, left))
+        return ftotal + 1, left, out, out2
+
+    def round_cond(st):
+        ftotal, left, out, out2 = st
+        return (left > 0) & (ftotal < tries)
+
+    _, _, out, out2 = lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), jnp.int32(out_size), out, out2)
+    )
+    out = jnp.where(out == UNDEF, ITEM_NONE, out)
+    out2 = jnp.where(out2 == UNDEF, ITEM_NONE, out2)
+    return out, out2, out_size
+
+
+def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
+    """Build the single-x mapping function for one rule; vmap/jit-ready.
+
+    Returns fn(x: u32 scalar, dev_weights: u32[max_devices]) -> i32[result_max]
+    mirroring crush_do_rule's result vector (padded with ITEM_NONE; the C
+    returns a length instead — callers mask on ITEM_NONE).
+    """
+    t = A.tunables
+    assert t.choose_local_tries == 0 and t.choose_local_fallback_tries == 0, (
+        "legacy local-retry tunables unsupported in the TPU kernel; "
+        "use mapper_ref"
+    )
+    rule = A.rules[ruleno]
+    assert rule is not None
+    d = _DeviceArrays(A)
+    weight_max = A.max_devices
+    RMAX = result_max
+
+    # static interpreter state
+    choose_tries = t.choose_total_tries + 1
+    choose_leaf_tries = 0
+    vary_r = t.chooseleaf_vary_r
+    stable = t.chooseleaf_stable
+
+    steps = []  # trace plan
+    for op, arg1, arg2 in rule.steps:
+        if op == RuleOp.SET_CHOOSE_TRIES:
+            if arg1 > 0:
+                choose_tries = arg1
+        elif op == RuleOp.SET_CHOOSELEAF_TRIES:
+            if arg1 > 0:
+                choose_leaf_tries = arg1
+        elif op == RuleOp.SET_CHOOSELEAF_VARY_R:
+            if arg1 >= 0:
+                vary_r = arg1
+        elif op == RuleOp.SET_CHOOSELEAF_STABLE:
+            if arg1 >= 0:
+                stable = arg1
+        elif op in (RuleOp.SET_CHOOSE_LOCAL_TRIES,
+                    RuleOp.SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+            assert arg1 == 0, "legacy local tries unsupported in TPU kernel"
+        else:
+            steps.append(
+                (op, arg1, arg2, choose_tries, choose_leaf_tries, vary_r,
+                 stable)
+            )
+
+    def fn(x, dev_weights):
+        x = jnp.asarray(x).astype(jnp.uint32)
+        w_items = jnp.full(RMAX, ITEM_NONE, jnp.int32)
+        wsize = jnp.int32(0)
+        wbound = 0  # static upper bound on wsize
+        result = jnp.full(RMAX, ITEM_NONE, jnp.int32)
+        rlen = jnp.int32(0)
+
+        for (op, arg1, arg2, s_tries, s_leaf_tries, s_vary_r,
+             s_stable) in steps:
+            if op == RuleOp.TAKE:
+                valid = (0 <= arg1 < A.max_devices) or (
+                    arg1 < 0 and -1 - arg1 < A.n_buckets
+                )
+                if valid:
+                    w_items = w_items.at[0].set(arg1)
+                    wsize = jnp.int32(1)
+                    wbound = 1
+            elif op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN,
+                        RuleOp.CHOOSE_INDEP, RuleOp.CHOOSELEAF_INDEP):
+                numrep = arg1 if arg1 > 0 else RMAX + arg1
+                if numrep <= 0 or wbound == 0:
+                    continue
+                firstn = op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN)
+                leafy = op in (RuleOp.CHOOSELEAF_FIRSTN,
+                               RuleOp.CHOOSELEAF_INDEP)
+                NR = min(numrep, RMAX)
+                if firstn:
+                    recurse_tries = (
+                        s_leaf_tries
+                        if s_leaf_tries
+                        else (1 if t.chooseleaf_descend_once else s_tries)
+                    )
+                else:
+                    recurse_tries = s_leaf_tries if s_leaf_tries else 1
+
+                o = jnp.full(RMAX, ITEM_NONE, jnp.int32)
+                osize = jnp.int32(0)
+                for i in range(min(wbound, RMAX)):
+                    src = w_items[i]
+                    src_ok = (i < wsize) & (src < 0) & (-1 - src < A.n_buckets)
+                    if firstn:
+                        count = jnp.where(
+                            src_ok, RMAX - osize, 0
+                        )
+                        vals, leafs, n = _choose_firstn_one(
+                            d, x, src, count, dev_weights,
+                            numrep=numrep, target_type=arg2,
+                            recurse_to_leaf=leafy, tries=s_tries,
+                            recurse_tries=recurse_tries,
+                            vary_r=s_vary_r, stable=s_stable,
+                            weight_max=weight_max, out_bound=NR,
+                        )
+                    else:
+                        out_size = jnp.where(
+                            src_ok,
+                            jnp.minimum(NR, RMAX - osize),
+                            0,
+                        )
+                        vals, leafs, n = _choose_indep_one(
+                            d, x, src, out_size, dev_weights,
+                            numrep=numrep, target_type=arg2,
+                            recurse_to_leaf=leafy, tries=s_tries,
+                            recurse_tries=recurse_tries,
+                            weight_max=weight_max, out_bound=NR,
+                        )
+                    emit_vals = leafs if leafy else vals
+                    # scatter emit_vals[:n] into o at osize
+                    idx = osize + jnp.arange(NR)
+                    keep = (jnp.arange(NR) < n) & (idx < RMAX)
+                    o = o.at[jnp.where(keep, idx, RMAX)].set(
+                        jnp.where(keep, emit_vals, ITEM_NONE),
+                        mode="drop",
+                    )
+                    osize = osize + n
+                w_items = o
+                wsize = jnp.minimum(osize, RMAX)
+                wbound = min(wbound * NR, RMAX)
+            elif op == RuleOp.EMIT:
+                idx = rlen + jnp.arange(RMAX)
+                keep = (jnp.arange(RMAX) < wsize) & (idx < RMAX)
+                result = result.at[jnp.where(keep, idx, RMAX)].set(
+                    jnp.where(keep, w_items, ITEM_NONE), mode="drop"
+                )
+                rlen = jnp.minimum(rlen + wsize, RMAX)
+                w_items = jnp.full(RMAX, ITEM_NONE, jnp.int32)
+                wsize = jnp.int32(0)
+                wbound = 0
+        return result
+
+    return fn
+
+
+def compile_batched(A: CrushArrays, ruleno: int, result_max: int):
+    """jit(vmap(...)): fn(xs: u32[N], dev_weights: u32[D]) -> i32[N, RMAX]."""
+    fn = compile_rule(A, ruleno, result_max)
+    return jax.jit(jax.vmap(fn, in_axes=(0, None)))

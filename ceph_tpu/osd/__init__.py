@@ -1,0 +1,2 @@
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+from ceph_tpu.osd.osdmap import OSDMap

@@ -3,12 +3,19 @@ without TPU hardware; the real-chip path is exercised by bench.py."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests.  The session environment pins JAX_PLATFORMS to the
+# TPU plugin and a sitecustomize imports jax at interpreter start, so the
+# env var is already captured — jax.config.update is the only reliable
+# override at this point.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 from pathlib import Path

@@ -1,0 +1,185 @@
+"""Differential tests: vmapped JAX mapper vs the Python reference mapper
+(which is itself differentially tested against the compiled C).  Exact
+element-wise equality on the padded result vectors."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.mapper_ref import do_rule
+from ceph_tpu.crush.mapper_jax import compile_batched
+from ceph_tpu.crush.soa import build_arrays
+from ceph_tpu.crush.types import (
+    BucketAlg,
+    ChooseArgs,
+    CrushMap,
+    ITEM_NONE,
+    Rule,
+    RuleOp,
+    Tunables,
+)
+
+from util_maps import build_flat, build_tree, HOST, RACK, ROOT
+
+N_X = 257
+
+
+def compare_jax(m, ruleno, weights, result_max, n_x=N_X, choose_args=None):
+    A = build_arrays(m, choose_args)
+    fn = compile_batched(A, ruleno, result_max)
+    xs = np.arange(n_x, dtype=np.uint32) * 2654435761 % (2**31)
+    dev_w = np.zeros(max(m.max_devices, 1), np.uint32)
+    dev_w[: len(weights)] = weights
+    got = np.asarray(fn(xs, dev_w))
+    if isinstance(choose_args, (int, str)):
+        choose_args = m.choose_args.get(choose_args)
+    for i, x in enumerate(xs):
+        want = do_rule(m, ruleno, int(x), result_max, list(weights),
+                       choose_args)
+        want = (want + [ITEM_NONE] * result_max)[:result_max]
+        assert list(got[i]) == want, (
+            f"x={x}: jax={list(got[i])} ref={want}"
+        )
+
+
+@pytest.mark.parametrize("alg", [BucketAlg.STRAW2, BucketAlg.STRAW,
+                                 BucketAlg.LIST, BucketAlg.TREE,
+                                 BucketAlg.UNIFORM])
+def test_flat_firstn(alg):
+    m, root = build_flat(17, alg)
+    r = m.make_replicated_rule(root, 0)
+    compare_jax(m, r, [0x10000] * 17, 3)
+
+
+@pytest.mark.parametrize("alg", [BucketAlg.STRAW2, BucketAlg.LIST,
+                                 BucketAlg.TREE, BucketAlg.UNIFORM])
+def test_flat_indep(alg):
+    m, root = build_flat(10, alg)
+    m.add_rule(Rule([(RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_INDEP, 0, 0),
+                     (RuleOp.EMIT, 0, 0)], type=3))
+    compare_jax(m, 0, [0x10000] * 10, 4)
+
+
+def test_flat_weighted_straw2(rng):
+    n = 25
+    weights = [int(w) for w in rng.integers(1, 8 * 0x10000, n)]
+    weights[3] = 0
+    m = CrushMap()
+    root = m.add_bucket(BucketAlg.STRAW2, ROOT, list(range(n)), weights)
+    r = m.make_replicated_rule(root, 0)
+    dev_w = [int(w) for w in rng.integers(0, 0x10001, n)]
+    compare_jax(m, r, dev_w, 3)
+
+
+@pytest.mark.parametrize("host_alg", [BucketAlg.STRAW2, BucketAlg.LIST,
+                                      BucketAlg.TREE, BucketAlg.UNIFORM,
+                                      BucketAlg.STRAW])
+def test_chooseleaf_firstn(rng, host_alg):
+    m, root = build_tree(rng, n_host=6, osd_per_host=4, host_alg=host_alg,
+                         weight_fn=lambda i: 0x10000 + (i % 5) * 0x4000)
+    r = m.make_replicated_rule(root, HOST)
+    w = [0x10000] * 24
+    w[3] = 0
+    w[10] = 0x8000
+    compare_jax(m, r, w, 3)
+
+
+@pytest.mark.parametrize("host_alg", [BucketAlg.STRAW2, BucketAlg.UNIFORM])
+def test_chooseleaf_indep_ec(rng, host_alg):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3, host_alg=host_alg)
+    r = m.make_erasure_rule(root, HOST)
+    w = [0x10000] * 24
+    w[7] = 0
+    compare_jax(m, r, w, 6)
+
+
+def test_three_level(rng):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3, n_rack=4)
+    r = m.make_replicated_rule(root, RACK)
+    compare_jax(m, r, [0x10000] * 24, 3)
+
+
+def test_choose_then_chooseleaf(rng):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3, n_rack=4)
+    m.add_rule(Rule([(RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_FIRSTN, 2, RACK),
+                     (RuleOp.CHOOSELEAF_FIRSTN, 2, HOST),
+                     (RuleOp.EMIT, 0, 0)]))
+    compare_jax(m, 0, [0x10000] * 24, 4)
+
+
+def test_firefly_tunables(rng):
+    t = Tunables.profile("firefly")
+    m, root = build_tree(rng, n_host=5, osd_per_host=4, tunables=t,
+                         weight_fn=lambda i: 0x10000 * (1 + i % 3))
+    r = m.make_replicated_rule(root, HOST)
+    w = [0x10000] * 20
+    w[2] = 0
+    w[7] = 0x4000
+    compare_jax(m, r, w, 3)
+
+
+def test_vary_r_stable_off(rng):
+    m, root = build_tree(rng, n_host=6, osd_per_host=4)
+    m.add_rule(Rule([
+        (RuleOp.SET_CHOOSELEAF_VARY_R, 0, 0),
+        (RuleOp.SET_CHOOSELEAF_STABLE, 0, 0),
+        (RuleOp.TAKE, root, 0),
+        (RuleOp.CHOOSELEAF_FIRSTN, 0, HOST),
+        (RuleOp.EMIT, 0, 0)]))
+    w = [0x10000] * 24
+    w[5] = 0
+    compare_jax(m, 0, w, 3)
+
+
+def test_choose_args(rng):
+    m, root = build_tree(rng, n_host=4, osd_per_host=4)
+    r = m.make_replicated_rule(root, HOST)
+    ca = ChooseArgs()
+    for bid, b in m.buckets.items():
+        ca.weight_sets[bid] = [
+            [int(w) for w in rng.integers(1, 4 * 0x10000, b.size)]
+            for _ in range(3)
+        ]
+    compare_jax(m, r, [0x10000] * 16, 3, choose_args=ca)
+
+
+def test_degenerate_numrep_exceeds(rng):
+    m, root = build_tree(rng, n_host=3, osd_per_host=2)
+    rr = m.make_replicated_rule(root, HOST)
+    re_ = m.make_erasure_rule(root, HOST)
+    compare_jax(m, rr, [0x10000] * 6, 3)
+    compare_jax(m, re_, [0x10000] * 6, 5)
+
+
+def test_all_out_devices(rng):
+    m, root = build_tree(rng, n_host=4, osd_per_host=2)
+    r = m.make_replicated_rule(root, HOST)
+    compare_jax(m, r, [0] * 8, 3)  # everything out -> empty result
+
+
+def test_indep_numrep_exceeds_result_max(rng):
+    """CHOOSE_INDEP with arg1 > result_max: the r-stride must use the full
+    numrep even though output is capped (review regression)."""
+    m, root = build_flat(12, BucketAlg.STRAW2)
+    m.add_rule(Rule([(RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_INDEP, 6, 0),
+                     (RuleOp.EMIT, 0, 0)], type=3))
+    w = [0x10000] * 12
+    for i in (1, 4, 6):
+        w[i] = 0  # force retries
+    compare_jax(m, 0, w, 3)
+
+
+def test_firstn_numrep_exceeds_result_max(rng):
+    """CHOOSE_FIRSTN with arg1 > result_max: skipped reps must be
+    compensated by later rep values (review regression)."""
+    m, root = build_flat(12, BucketAlg.STRAW2)
+    m.add_rule(Rule([(RuleOp.SET_CHOOSE_TRIES, 2, 0),
+                     (RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_FIRSTN, 6, 0),
+                     (RuleOp.EMIT, 0, 0)]))
+    w = [0x10000] * 12
+    for i in (0, 2, 3, 5, 7, 8, 10):
+        w[i] = 0
+    compare_jax(m, 0, w, 3)
