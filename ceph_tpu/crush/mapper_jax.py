@@ -534,36 +534,47 @@ def _choose_indep_one(
             # the leaf recursion's parent_r is the full r of the level where
             # the walk found the item (reference src/crush/mapper.c:794)
             r_leaf = r_last
-            collide = jnp.any(
+            found_nc = (status == _FOUND) & ~jnp.any(
                 jnp.where(jnp.arange(NR) < out_size, out, ITEM_NONE) == cand
-            ) & (status == _FOUND)
+            )
+            dev = cand >= 0
             if recurse_to_leaf:
                 lf, lok = _leaf_indep(
                     d, x, cand, r_leaf, rep, numrep, recurse_tries,
                     dev_weights, weight_max,
                 )
-                dev = cand >= 0
-                if target_type == 0:
-                    lf = jnp.where(dev, cand, lf)
-                    lok = jnp.where(dev, jnp.bool_(True), lok)
-                leaf_fail = ~lok
+                # a found *device* is written to out2 before the is_out
+                # check (reference src/crush/mapper.c:799-801), so a
+                # rejected device stays in out2 and is emitted if every
+                # try fails; a failed bucket recursion writes NONE
+                # (src/crush/mapper.c:794-797 + the recursion's own
+                # UNDEF->NONE conversion).
+                leaf_val = jnp.where(
+                    dev, cand, jnp.where(lok, lf, jnp.int32(ITEM_NONE))
+                )
+                leaf_ok = lok | dev
+                leaf_fail = ~leaf_ok
             else:
-                lf = cand
+                leaf_val = cand
+                leaf_ok = jnp.bool_(True)
                 leaf_fail = jnp.bool_(False)
             if target_type == 0:
                 reject = _is_out(x, cand, dev_weights, weight_max)
             else:
                 reject = jnp.bool_(False)
             hard = status == _SKIP  # bad item => NONE + left--
-            good = (
-                (status == _FOUND) & ~collide & ~leaf_fail & ~reject
-            )
+            good = found_nc & ~leaf_fail & ~reject
             newv = jnp.where(
                 hard, jnp.int32(ITEM_NONE), jnp.where(good, cand, UNDEF)
             )
-            newl = jnp.where(
-                hard, jnp.int32(ITEM_NONE), jnp.where(good, lf, UNDEF)
-            )
+            if recurse_to_leaf:
+                newl = jnp.where(
+                    hard,
+                    jnp.int32(ITEM_NONE),
+                    jnp.where(found_nc, leaf_val, out2[rep]),
+                )
+            else:
+                newl = newv
             out = out.at[rep].set(jnp.where(todo, newv, out[rep]))
             out2 = out2.at[rep].set(jnp.where(todo, newl, out2[rep]))
             left = left - jnp.where(todo & (hard | good), 1, 0)

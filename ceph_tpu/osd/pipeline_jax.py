@@ -1,0 +1,433 @@
+"""Batched PG→OSD pipeline — the full placement stack as one XLA call.
+
+TPU-native re-expression of the reference's 5-stage mapping
+(reference src/osd/OSDMap.cc:2435-2715): for every PG of a pool,
+
+    ps ──stable_mod──► pps ──crush rule kernel──► raw ──upmap──► up ──►
+        primary affinity ──► (up, up_primary) ──pg_temp──► (acting, acting_primary)
+
+The CRUSH rule kernel is the vmapped trace from ceph_tpu.crush.mapper_jax;
+everything around it is masked lane arithmetic on [W]-wide vectors (W = pool
+size, <= ~20), so the whole pipeline fuses into the rule kernel's program and
+the PG axis shards freely over a device mesh.
+
+Sparse host-side overrides (pg_upmap, pg_upmap_items, pg_temp, primary_temp —
+hash maps in the reference, reference src/osd/OSDMap.h:567-575) become dense
+per-PG tensors built once by `build_overlays`; each overlay stage is gated by
+a *static* flag so the no-override case (the big-batch benchmark) compiles to
+nothing.
+
+Bit-exactness contract: same results as OSDMap._pg_to_up_acting_osds (the
+host oracle in ceph_tpu.osd.osdmap) for every PG, padded to a fixed width
+with CRUSH_ITEM_NONE; differential-tested in tests/test_pipeline_jax.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.core.intmath import pg_mask_for, stable_mod
+from ceph_tpu.core.rjenkins import crush_hash32_2
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.mapper_jax import compile_rule
+from ceph_tpu.crush.soa import CrushArrays, build_arrays
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.osdmap import (
+    DEFAULT_PRIMARY_AFFINITY,
+    MAX_PRIMARY_AFFINITY,
+    OSDMap,
+)
+from ceph_tpu.osd.types import FLAG_HASHPSPOOL
+
+
+def _h2(a, b):
+    return crush_hash32_2(
+        jnp.asarray(a).astype(jnp.uint32),
+        jnp.asarray(b).astype(jnp.uint32),
+        xp=jnp,
+    )
+
+
+@dataclass(frozen=True)
+class PoolSpec:
+    """Static per-pool parameters baked into the compiled pipeline."""
+
+    pool_id: int
+    size: int
+    pg_num: int
+    pgp_num: int
+    can_shift: bool  # replicated pools compact; EC pools are positional
+    hashpspool: bool
+    ruleno: int
+    max_osd: int  # OSDMap::max_osd (exists/upmap id bound)
+    out_width: int  # padded output width (>= size)
+
+    @classmethod
+    def for_pool(
+        cls, m: OSDMap, pool_id: int, extra_width: int = 0
+    ) -> "PoolSpec":
+        pool = m.pools[pool_id]
+        ruleno = mapper_ref.find_rule(
+            m.crush, pool.crush_rule, int(pool.type), pool.size
+        )
+        return cls(
+            pool_id=pool_id,
+            size=pool.size,
+            pg_num=pool.pg_num,
+            pgp_num=pool.pgp_num,
+            can_shift=pool.can_shift_osds(),
+            hashpspool=bool(pool.flags & FLAG_HASHPSPOOL),
+            ruleno=ruleno,
+            max_osd=m.max_osd,
+            out_width=max(pool.size, extra_width),
+        )
+
+
+@dataclass
+class Overlays:
+    """Dense per-PG override tensors for one pool ([N = pg_num] rows).
+    All-empty overlays are represented as None fields; the static gates in
+    compile_pipeline key off which fields are present."""
+
+    upmap_full: np.ndarray | None = None  # [N, Wu] i32, NONE-padded
+    upmap_len: np.ndarray | None = None  # [N] i32 (0 = no entry)
+    upmap_pairs: np.ndarray | None = None  # [N, P, 2] i32, NONE-padded
+    temp: np.ndarray | None = None  # [N, Wt] i32, NONE-padded
+    temp_len: np.ndarray | None = None  # [N] i32 (-1 = no entry)
+    primary_temp: np.ndarray | None = None  # [N] i32 (-1 = none)
+
+    @property
+    def n_pairs(self) -> int:
+        return 0 if self.upmap_pairs is None else self.upmap_pairs.shape[1]
+
+    @property
+    def extra_width(self) -> int:
+        w = 0
+        if self.upmap_full is not None:
+            w = max(w, self.upmap_full.shape[1])
+        if self.temp is not None:
+            w = max(w, self.temp.shape[1])
+        return w
+
+
+def build_overlays(m: OSDMap, pool_id: int) -> Overlays:
+    """Freeze the sparse override dicts into dense per-PG tensors."""
+    pool = m.pools[pool_id]
+    n = pool.pg_num
+    ov = Overlays()
+
+    full = {
+        pg.seed: v
+        for pg, v in m.pg_upmap.items()
+        if pg.pool == pool_id and pg.seed < n
+    }
+    if full:
+        w = max(len(v) for v in full.values())
+        ov.upmap_full = np.full((n, w), ITEM_NONE, np.int32)
+        ov.upmap_len = np.zeros(n, np.int32)
+        for s, v in full.items():
+            ov.upmap_full[s, : len(v)] = v
+            ov.upmap_len[s] = len(v)
+
+    items = {
+        pg.seed: v
+        for pg, v in m.pg_upmap_items.items()
+        if pg.pool == pool_id and pg.seed < n
+    }
+    if items:
+        p = max(len(v) for v in items.values())
+        ov.upmap_pairs = np.full((n, p, 2), ITEM_NONE, np.int32)
+        for s, v in items.items():
+            for j, (frm, to) in enumerate(v):
+                ov.upmap_pairs[s, j] = (frm, to)
+
+    temps = {
+        pg.seed: v
+        for pg, v in m.pg_temp.items()
+        if pg.pool == pool_id and pg.seed < n
+    }
+    if temps:
+        w = max((len(v) for v in temps.values()), default=1) or 1
+        ov.temp = np.full((n, w), ITEM_NONE, np.int32)
+        ov.temp_len = np.full(n, -1, np.int32)
+        for s, v in temps.items():
+            ov.temp[s, : len(v)] = v
+            ov.temp_len[s] = len(v)
+
+    prim = {
+        pg.seed: v
+        for pg, v in m.primary_temp.items()
+        if pg.pool == pool_id and pg.seed < n
+    }
+    if prim:
+        ov.primary_temp = np.full(n, -1, np.int32)
+        for s, v in prim.items():
+            ov.primary_temp[s] = v
+    return ov
+
+
+def _compact(v, keep, width):
+    """Stable left-compaction of kept lanes, NONE-padded (the vector `erase`
+    loops of reference src/osd/OSDMap.cc:2416-2427, 2516-2522)."""
+    idx = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    out = jnp.full(width, ITEM_NONE, jnp.int32)
+    return out.at[jnp.where(keep, idx, width)].set(
+        jnp.where(keep, v, ITEM_NONE), mode="drop"
+    )
+
+
+def _pad_lanes(v, width):
+    n = v.shape[0]
+    if n >= width:
+        return v[:width]
+    return jnp.concatenate(
+        [v, jnp.full(width - n, ITEM_NONE, v.dtype)]
+    )
+
+
+def _first_not_none(v):
+    """_pick_primary (reference src/osd/OSDMap.cc:2455-2463)."""
+    ok = v != ITEM_NONE
+    i = jnp.argmax(ok)
+    return jnp.where(jnp.any(ok), v[i], -1)
+
+
+def compile_pipeline(
+    A: CrushArrays,
+    spec: PoolSpec,
+    *,
+    with_upmap_full: bool = False,
+    n_upmap_pairs: int = 0,
+    with_temp: bool = False,
+    with_primary_temp: bool = False,
+    with_primary_affinity: bool = True,
+):
+    """Build the single-PG mapping function for one pool; vmap/jit-ready.
+
+    Returns fn(ps, dev, ov) -> (up[W], up_primary, acting[W], acting_primary)
+    where `dev` is the padded dict built by PoolMapper (exists/up bool[DV],
+    weight/primary_affinity u32[DV], DV = max(crush devices, max_osd)) and
+    `ov` holds this PG's overlay rows (only statically-enabled ones read).
+    """
+    W = spec.out_width
+    R = spec.size
+    rule_fn = compile_rule(A, spec.ruleno, R) if spec.ruleno >= 0 else None
+    D = A.max_devices  # crush device-id bound (weight vec for the kernel)
+    MO = spec.max_osd  # OSDMap id bound (exists / upmap targets)
+    DV = max(D, MO, 1)
+    pgp_mask = pg_mask_for(spec.pgp_num)
+
+    def fn(ps, dev, ov):
+        ps = jnp.asarray(ps).astype(jnp.uint32)
+        exists = dev["exists"]  # bool[DV]
+        upb = dev["up"]  # bool[DV]
+        weight = dev["weight"]  # u32[DV]
+        aff = dev["primary_affinity"]  # u32[DV]
+
+        def osd_ok(v, tbl):
+            """valid OSDMap id with tbl true (exists()/is_up() lookups)."""
+            return (v >= 0) & (v < MO) & tbl[jnp.clip(v, 0, DV - 1)]
+
+        # -- stage 1: placement seed (reference src/osd/osd_types.cc:1798) -
+        ps2 = stable_mod(ps, spec.pgp_num, pgp_mask, xp=jnp)
+        if spec.hashpspool:
+            pps = _h2(ps2, spec.pool_id & 0xFFFFFFFF)
+        else:
+            pps = (ps2 + jnp.uint32(spec.pool_id)).astype(jnp.uint32)
+
+        # -- stage 2: CRUSH (reference src/osd/OSDMap.cc:2444-2447) --------
+        if rule_fn is None:
+            raw = jnp.full(W, ITEM_NONE, jnp.int32)
+        else:
+            raw = _pad_lanes(rule_fn(pps, weight[:D]), W)
+
+        # -- _remove_nonexistent_osds (reference src/osd/OSDMap.cc:2412) ---
+        if spec.can_shift:
+            raw = _compact(raw, osd_ok(raw, exists), W)
+        else:
+            raw = jnp.where(
+                osd_ok(raw, exists) | (raw == ITEM_NONE), raw, ITEM_NONE
+            )
+
+        # -- stage 3: upmap (reference src/osd/OSDMap.cc:2465-2509) --------
+        def marked_out(v):
+            """the reject guard: valid id AND weight 0 (OSDMap.cc:2472,2496)."""
+            return (
+                (v != ITEM_NONE) & (v >= 0) & (v < MO)
+                & (weight[jnp.clip(v, 0, DV - 1)] == 0)
+            )
+
+        # a pg_upmap entry with an out target aborts the whole _apply_upmap
+        # (the early `return` at reference src/osd/OSDMap.cc:2474), skipping
+        # pg_upmap_items as well
+        upmap_aborted = jnp.bool_(False)
+        if with_upmap_full:
+            row = ov["upmap_full"]  # [Wu <= W]
+            rl = ov["upmap_len"]
+            lane_u = jnp.arange(row.shape[0])
+            bad = jnp.any(marked_out(row) & (lane_u < rl))
+            upmap_aborted = (rl > 0) & bad
+            ok = (rl > 0) & ~bad
+            repl = jnp.where(jnp.arange(W) < rl, _pad_lanes(row, W), ITEM_NONE)
+            raw = jnp.where(ok, repl, raw)
+        if n_upmap_pairs:
+            pairs = ov["upmap_pairs"]  # [P, 2]
+            lane = jnp.arange(W)
+            for j in range(n_upmap_pairs):
+                frm, to = pairs[j, 0], pairs[j, 1]
+                present = jnp.any(raw == to)
+                match = (raw == frm) & ~marked_out(to)
+                pos = jnp.argmax(match)
+                do = (
+                    (frm != ITEM_NONE) & ~present & jnp.any(match)
+                    & ~upmap_aborted
+                )
+                raw = jnp.where(do & (lane == pos), to, raw)
+
+        # -- stage 4: raw → up (reference src/osd/OSDMap.cc:2512-2535) -----
+        alive = osd_ok(raw, exists & upb)
+        if spec.can_shift:
+            up = _compact(raw, alive, W)
+        else:
+            up = jnp.where(alive, raw, ITEM_NONE)
+        up_primary = _first_not_none(up)
+
+        # -- stage 5: primary affinity (reference src/osd/OSDMap.cc:2537) --
+        if with_primary_affinity:
+            nonnone = up != ITEM_NONE
+            a = aff[jnp.clip(up, 0, DV - 1)]
+            gate = jnp.any(nonnone & (a != DEFAULT_PRIMARY_AFFINITY))
+            h = (_h2(pps, up) >> 16).astype(jnp.uint32)
+            rejected = nonnone & (a < MAX_PRIMARY_AFFINITY) & (h >= a)
+            accepted = nonnone & ~rejected
+            lane = jnp.arange(W)
+            pos = jnp.where(
+                jnp.any(accepted),
+                jnp.argmax(accepted),
+                jnp.where(jnp.any(nonnone), jnp.argmax(nonnone), -1),
+            )
+            do = gate & (pos >= 0)
+            new_primary = jnp.where(do, up[jnp.maximum(pos, 0)], up_primary)
+            if spec.can_shift:
+                shifted = jnp.where(
+                    (lane > 0) & (lane <= pos),
+                    up[jnp.maximum(lane - 1, 0)],
+                    up,
+                )
+                shifted = shifted.at[0].set(new_primary)
+                up = jnp.where(do & (pos > 0), shifted, up)
+            up_primary = new_primary
+
+        # -- pg_temp / primary_temp (reference src/osd/OSDMap.cc:2592) -----
+        acting, acting_primary = up, up_primary
+        if with_temp or with_primary_temp:
+            pt = ov["primary_temp"] if with_primary_temp else jnp.int32(-1)
+            if with_temp:
+                trow = _pad_lanes(ov["temp"], W)  # Wt <= W by construction
+                tlen = ov["temp_len"]
+                has_temp = tlen >= 0
+                in_row = jnp.arange(W) < tlen
+                t_alive = osd_ok(trow, exists & upb) & in_row
+                if spec.can_shift:
+                    filt = _compact(trow, t_alive, W)
+                    t_n = jnp.sum(t_alive.astype(jnp.int32))
+                else:
+                    filt = jnp.where(t_alive, trow, ITEM_NONE)
+                    filt = jnp.where(in_row, filt, ITEM_NONE)
+                    t_n = jnp.maximum(tlen, 0)
+                t_primary = jnp.where(pt >= 0, pt, _first_not_none(filt))
+                use_temp = has_temp & (t_n > 0)
+                acting = jnp.where(use_temp, filt, up)
+                acting_primary = jnp.where(
+                    use_temp, t_primary, jnp.where(pt >= 0, pt, up_primary)
+                )
+            else:
+                acting_primary = jnp.where(pt >= 0, pt, up_primary)
+        return up, up_primary, acting, acting_primary
+
+    return fn
+
+
+class PoolMapper:
+    """Compiled batched mapper for one pool of one OSDMap.
+
+    Usage:
+        pm = PoolMapper(osdmap, pool_id)
+        up, up_primary, acting, acting_primary = pm.map_all()
+    """
+
+    def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True):
+        self.m = m
+        self.pool_id = pool_id
+        ca = m.crush.choose_args.get(pool_id, m.crush.choose_args.get(-1))
+        self.arrays = build_arrays(m.crush, ca)
+        self.ov = build_overlays(m, pool_id) if overlays else Overlays()
+        self.spec = PoolSpec.for_pool(
+            m, pool_id, extra_width=self.ov.extra_width
+        )
+        self.fn = compile_pipeline(
+            self.arrays,
+            self.spec,
+            with_upmap_full=self.ov.upmap_full is not None,
+            n_upmap_pairs=self.ov.n_pairs,
+            with_temp=self.ov.temp is not None,
+            with_primary_temp=self.ov.primary_temp is not None,
+            with_primary_affinity=m.osd_primary_affinity is not None,
+        )
+        dv = m.frozen_vectors()
+        DV = max(self.arrays.max_devices, m.max_osd, 1)
+        self.dev = {
+            "exists": _pad_to(dv["exists"], DV, False),
+            "up": _pad_to(dv["up"], DV, False),
+            "weight": _pad_to(dv["weight"], DV, 0),
+            "primary_affinity": _pad_to(
+                dv["primary_affinity"], DV, DEFAULT_PRIMARY_AFFINITY
+            ),
+        }
+        self._jitted = None
+
+    def _ov_rows(self, ps: np.ndarray) -> dict:
+        ov, rows = self.ov, {}
+        if ov.upmap_full is not None:
+            rows["upmap_full"] = jnp.asarray(ov.upmap_full[ps])
+            rows["upmap_len"] = jnp.asarray(ov.upmap_len[ps])
+        if ov.upmap_pairs is not None:
+            rows["upmap_pairs"] = jnp.asarray(ov.upmap_pairs[ps])
+        if ov.temp is not None:
+            rows["temp"] = jnp.asarray(ov.temp[ps])
+            rows["temp_len"] = jnp.asarray(ov.temp_len[ps])
+        if ov.primary_temp is not None:
+            rows["primary_temp"] = jnp.asarray(ov.primary_temp[ps])
+        return rows
+
+    def map_batch(self, ps: np.ndarray):
+        """Map a batch of placement seeds.  Returns numpy
+        (up[N,W], up_primary[N], acting[N,W], acting_primary[N])."""
+        if self._jitted is None:
+            self._jitted = jax.jit(jax.vmap(self.fn, in_axes=(0, None, 0)))
+        ps = np.asarray(ps)
+        out = self._jitted(
+            jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
+        )
+        return tuple(np.asarray(o) for o in out)
+
+    def map_all(self):
+        return self.map_batch(np.arange(self.spec.pg_num, dtype=np.uint32))
+
+
+def map_cluster(m: OSDMap) -> dict[int, tuple]:
+    """Map every pool; returns {pool_id: (up, up_primary, acting,
+    acting_primary)} — the batched equivalent of the osdmaptool
+    --test-map-pgs loop (reference src/tools/osdmaptool.cc:630-755)."""
+    return {pid: PoolMapper(m, pid).map_all() for pid in sorted(m.pools)}
+
+
+def _pad_to(v: np.ndarray, n: int, fill) -> jnp.ndarray:
+    v = np.asarray(v)
+    if v.shape[0] < n:
+        v = np.concatenate([v, np.full(n - v.shape[0], fill, v.dtype)])
+    return jnp.asarray(v[:n])

@@ -8,7 +8,7 @@ crush_do_rule.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import IntEnum
 
 from ceph_tpu.core.intmath import pg_mask_for
@@ -103,6 +103,10 @@ class PgPool:
     def hash_key(self, key: str, ns: str = "") -> int:
         """object name (+namespace) -> 32-bit hash (reference
         src/osd/osd_types.cc:1766-1777)."""
+        if self.object_hash != 2:  # CEPH_STR_HASH_RJENKINS
+            raise NotImplementedError(
+                f"object_hash {self.object_hash} (only rjenkins supported)"
+            )
         if not ns:
             return str_hash_rjenkins(key.encode())
         return str_hash_rjenkins(ns.encode() + b"\x1f" + key.encode())

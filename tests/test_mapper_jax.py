@@ -183,3 +183,19 @@ def test_firstn_numrep_exceeds_result_max(rng):
     for i in (0, 2, 3, 5, 7, 8, 10):
         w[i] = 0
     compare_jax(m, 0, w, 3)
+
+
+def test_chooseleaf_indep_type0_stale_out2():
+    """reference src/crush/mapper.c:799-801: a found device is written to
+    out2 before the is_out check, so an always-rejected (weight-0) device is
+    still emitted after tries exhaust."""
+    m, root = build_flat(8)
+    ruleno = m.add_rule(Rule([
+        (RuleOp.TAKE, root, 0),
+        (RuleOp.CHOOSELEAF_INDEP, 4, 0),
+        (RuleOp.EMIT, 0, 0),
+    ], type=3))
+    weights = [0x10000] * 8
+    for dead in (2, 5, 6):
+        weights[dead] = 0
+    compare_jax(m, ruleno, weights, 4, n_x=64)

@@ -1,0 +1,227 @@
+"""Differential tests: batched JAX PG→OSD pipeline vs the host OSDMap oracle
+(reference semantics src/osd/OSDMap.cc:2435-2715).  Exact equality of the
+padded (up, up_primary, acting, acting_primary) tuples for every PG."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.osdmap import OSDMap, build_hierarchical, build_simple
+from ceph_tpu.osd.pipeline_jax import PoolMapper
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+
+
+def check_pool(m: OSDMap, pool_id: int):
+    pm = PoolMapper(m, pool_id)
+    up, upp, acting, actp = pm.map_all()
+    W = up.shape[1]
+    pool = m.pools[pool_id]
+    for ps in range(pool.pg_num):
+        w_up, w_upp, w_act, w_actp = m.pg_to_up_acting_osds(
+            PgId(pool_id, ps)
+        )
+        pad = lambda v: (list(v) + [ITEM_NONE] * W)[:W]
+        assert list(up[ps]) == pad(w_up), (ps, list(up[ps]), w_up)
+        assert upp[ps] == w_upp, (ps, upp[ps], w_upp)
+        assert list(acting[ps]) == pad(w_act), (ps, list(acting[ps]), w_act)
+        assert actp[ps] == w_actp, (ps, actp[ps], w_actp)
+
+
+def hier_map(rng, pool=None, n_host=8, osd_per_host=4, **kw):
+    pool = pool or PgPool(pg_num=128, size=3)
+    return build_hierarchical(
+        n_host, osd_per_host, pool=pool,
+        weight_fn=lambda i: int(rng.integers(1, 4)) * 0x8000, **kw
+    )
+
+
+def test_replicated_clean(rng):
+    check_pool(hier_map(rng), 0)
+
+
+def test_build_simple():
+    m = build_simple(8, pg_bits=4)
+    check_pool(m, 0)
+
+
+def test_replicated_down_out(rng):
+    m = hier_map(rng)
+    for o in rng.choice(m.max_osd, 6, replace=False):
+        m.mark_down(int(o))
+    for o in rng.choice(m.max_osd, 5, replace=False):
+        m.mark_out(int(o))
+    check_pool(m, 0)
+
+
+def test_erasure_down_out(rng):
+    pool = PgPool(type=PoolType.ERASURE, size=6, pg_num=128, crush_rule=1)
+    m = hier_map(rng, pool)
+    m.crush.make_erasure_rule(
+        min(m.crush.buckets.keys(), key=lambda b: -m.crush.buckets[b].type), 1
+    )
+    # rule index: make_replicated_rule was rule 0, erasure is rule 1 with
+    # ruleset 1 — pool.crush_rule must match the ruleset
+    for o in rng.choice(m.max_osd, 6, replace=False):
+        m.mark_down(int(o))
+    for o in rng.choice(m.max_osd, 4, replace=False):
+        m.mark_out(int(o))
+    check_pool(m, 0)
+
+
+def test_primary_affinity(rng):
+    m = hier_map(rng)
+    for o in range(m.max_osd):
+        r = rng.integers(0, 4)
+        if r == 0:
+            m.set_primary_affinity(o, 0)
+        elif r == 1:
+            m.set_primary_affinity(o, int(rng.integers(0, 0x10000)))
+    check_pool(m, 0)
+
+
+def test_upmap_full_and_items(rng):
+    m = hier_map(rng)
+    pool = m.pools[0]
+    for ps in rng.choice(pool.pg_num, 20, replace=False):
+        ps = int(ps)
+        kind = rng.integers(0, 2)
+        if kind == 0:
+            tgt = [int(o) for o in rng.choice(m.max_osd, 3, replace=False)]
+            m.pg_upmap[PgId(0, ps)] = tgt
+        else:
+            frm = int(rng.integers(0, m.max_osd))
+            to = int(rng.integers(0, m.max_osd))
+            m.pg_upmap_items[PgId(0, ps)] = [(frm, to)]
+    # some targets marked out to exercise the reject guards
+    for o in rng.choice(m.max_osd, 4, replace=False):
+        m.mark_out(int(o))
+    check_pool(m, 0)
+
+
+def test_upmap_multi_pairs(rng):
+    m = hier_map(rng)
+    pool = m.pools[0]
+    # build pairs from actual raw mappings so swaps really engage
+    for ps in range(0, pool.pg_num, 3):
+        raw, _ = m.pg_to_raw_osds(PgId(0, ps))
+        if len(raw) < 2:
+            continue
+        to1 = int((raw[0] + 1) % m.max_osd)
+        to2 = int((raw[1] + 7) % m.max_osd)
+        m.pg_upmap_items[PgId(0, ps)] = [(raw[0], to1), (raw[1], to2)]
+    check_pool(m, 0)
+
+
+def test_pg_temp_primary_temp(rng):
+    m = hier_map(rng)
+    pool = m.pools[0]
+    for ps in rng.choice(pool.pg_num, 24, replace=False):
+        ps = int(ps)
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            tgt = [int(o) for o in rng.choice(m.max_osd, 3, replace=False)]
+            m.pg_temp[PgId(0, ps)] = tgt
+        elif kind == 1:
+            m.primary_temp[PgId(0, ps)] = int(rng.integers(0, m.max_osd))
+        else:
+            tgt = [int(o) for o in rng.choice(m.max_osd, 2, replace=False)]
+            m.pg_temp[PgId(0, ps)] = tgt
+            m.primary_temp[PgId(0, ps)] = tgt[-1]
+    for o in rng.choice(m.max_osd, 8, replace=False):
+        m.mark_down(int(o))
+    check_pool(m, 0)
+
+
+def test_ec_pg_temp(rng):
+    pool = PgPool(type=PoolType.ERASURE, size=4, pg_num=64, crush_rule=1)
+    m = hier_map(rng, pool)
+    m.crush.make_erasure_rule(
+        min(m.crush.buckets.keys(), key=lambda b: -m.crush.buckets[b].type), 1
+    )
+    for ps in rng.choice(pool.pg_num, 10, replace=False):
+        ps = int(ps)
+        m.pg_temp[PgId(0, ps)] = [
+            int(o) for o in rng.choice(m.max_osd, 4, replace=False)
+        ]
+    for o in rng.choice(m.max_osd, 6, replace=False):
+        m.mark_down(int(o))
+    check_pool(m, 0)
+
+
+def test_everything_at_once(rng):
+    """All overlays + degraded cluster + affinity, replicated."""
+    m = hier_map(rng, PgPool(pg_num=256, size=3), n_host=12, n_rack=3)
+    pool = m.pools[0]
+    for o in range(m.max_osd):
+        if rng.integers(0, 5) == 0:
+            m.set_primary_affinity(o, int(rng.integers(0, 0x10001)))
+    for o in rng.choice(m.max_osd, 10, replace=False):
+        m.mark_down(int(o))
+    for o in rng.choice(m.max_osd, 8, replace=False):
+        m.mark_out(int(o))
+    for ps in rng.choice(pool.pg_num, 40, replace=False):
+        ps = int(ps)
+        k = rng.integers(0, 4)
+        if k == 0:
+            m.pg_upmap[PgId(0, ps)] = [
+                int(o) for o in rng.choice(m.max_osd, 3, replace=False)
+            ]
+        elif k == 1:
+            m.pg_upmap_items[PgId(0, ps)] = [
+                (int(rng.integers(0, m.max_osd)),
+                 int(rng.integers(0, m.max_osd))),
+                (int(rng.integers(0, m.max_osd)),
+                 int(rng.integers(0, m.max_osd))),
+            ]
+        elif k == 2:
+            m.pg_temp[PgId(0, ps)] = [
+                int(o) for o in rng.choice(m.max_osd, 3, replace=False)
+            ]
+        else:
+            m.primary_temp[PgId(0, ps)] = int(rng.integers(0, m.max_osd))
+    check_pool(m, 0)
+
+
+def test_nonhashpspool(rng):
+    pool = PgPool(pg_num=64, size=3, flags=0)
+    check_pool(hier_map(rng, pool), 0)
+
+
+def test_non_pow2_pg_num(rng):
+    pool = PgPool(pg_num=100, size=3, pgp_num=96)
+    check_pool(hier_map(rng, pool), 0)
+
+
+def test_upmap_rejected_full_skips_items(rng):
+    """The early `return` of reference src/osd/OSDMap.cc:2474: a pg_upmap
+    with an out target must also suppress pg_upmap_items for that PG."""
+    m = hier_map(rng)
+    m.mark_out(1)
+    for ps in range(0, 32):
+        raw, _ = m.pg_to_raw_osds(PgId(0, ps))
+        m.pg_upmap[PgId(0, ps)] = [0, 1, 2]  # osd.1 is out -> rejected
+        if raw:
+            m.pg_upmap_items[PgId(0, ps)] = [(raw[0], (raw[0] + 9) % 32)]
+    check_pool(m, 0)
+
+
+def test_primary_temp_without_pg_temp(rng):
+    m = hier_map(rng)
+    for ps in range(0, 64, 5):
+        m.primary_temp[PgId(0, ps)] = int(rng.integers(0, m.max_osd))
+    check_pool(m, 0)
+
+
+def test_choose_args_default_fallback(rng):
+    """choose_args_get_with_fallback (reference src/crush/CrushWrapper.h:
+    1451-1457): pool id missing -> the DEFAULT_CHOOSE_ARGS (-1) set."""
+    from ceph_tpu.crush.types import ChooseArgs
+
+    m = hier_map(rng)
+    ca = ChooseArgs()
+    for bid, b in m.crush.buckets.items():
+        ca.weight_sets[bid] = [
+            [max(1, w // 2 + int(rng.integers(0, w + 1))) for w in b.weights]
+        ]
+    m.crush.choose_args[-1] = ca
+    check_pool(m, 0)
