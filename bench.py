@@ -1,0 +1,104 @@
+"""Headline benchmark: batched CRUSH PG→OSD mapping throughput.
+
+Measures the full 5-stage placement pipeline (ceph_tpu.osd.pipeline_jax) on
+the default jax device (the real TPU chip when present), vs the single-core
+C reference kernel (`crush_do_rule` in a tight loop — the hot loop of
+`crushtool --test`, reference src/crush/CrushTester.cc:612-623) compiled
+from the read-only reference mount.
+
+Prints ONE JSON line:
+    {"metric": "pg_mappings_per_sec", "value": N, "unit": "mappings/s",
+     "vs_baseline": N/<single-core C mappings/s>}
+
+Env knobs: BENCH_PGS (default 1_000_000), BENCH_OSDS (default 1024),
+BENCH_BASELINE_PGS (default 200_000).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
+
+N_PGS = int(os.environ.get("BENCH_PGS", 1_000_000))
+N_OSDS = int(os.environ.get("BENCH_OSDS", 1024))
+BASELINE_PGS = int(os.environ.get("BENCH_BASELINE_PGS", 200_000))
+OSD_PER_HOST = 8
+
+
+def build_map():
+    from ceph_tpu.osd.osdmap import build_hierarchical
+    from ceph_tpu.osd.types import PgPool, PoolType
+
+    n_host = max(1, N_OSDS // OSD_PER_HOST)
+    pool = PgPool(
+        type=PoolType.REPLICATED, size=3, crush_rule=0,
+        pg_num=N_PGS, pgp_num=N_PGS,
+    )
+    return build_hierarchical(
+        n_host, OSD_PER_HOST, n_rack=max(1, n_host // 16), pool=pool
+    )
+
+
+def bench_tpu(m) -> float:
+    """Mappings/sec of the jitted batched pipeline (steady-state)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+    pm = PoolMapper(m, 0, overlays=False)
+    fn = jax.jit(jax.vmap(pm.fn, in_axes=(0, None, 0)))
+    ps = jax.device_put(jnp.arange(N_PGS, dtype=jnp.uint32))
+    dev = jax.device_put(pm.dev)
+    jax.block_until_ready(fn(ps, dev, {}))  # compile + warm
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(ps, dev, {})
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    return N_PGS / dt
+
+
+def bench_c_reference(m) -> float | None:
+    """Single-core C crush_do_rule loop; mappings/sec, None if unavailable."""
+    try:
+        from util_maps import to_oracle
+    except Exception:
+        return None
+    try:
+        om = to_oracle(m.crush)
+    except (AssertionError, ImportError, OSError):
+        return None
+    weights = list(m.osd_weight)
+    n = min(BASELINE_PGS, N_PGS)
+    # warm once, then measure
+    om.bench_rule(0, 0, min(n, 1000), 1, weights, 3)
+    ns, _ = om.bench_rule(0, 0, n, 1, weights, 3)
+    if ns <= 0:
+        return None
+    return n / (ns * 1e-9)
+
+
+def main():
+    m = build_map()
+    tpu_rate = bench_tpu(m)
+    c_rate = bench_c_reference(m)
+    vs = tpu_rate / c_rate if c_rate else 0.0
+    print(json.dumps({
+        "metric": "pg_mappings_per_sec",
+        "value": round(tpu_rate, 1),
+        "unit": "mappings/s",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

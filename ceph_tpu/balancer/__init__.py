@@ -1,0 +1,3 @@
+from ceph_tpu.balancer.upmap import calc_pg_upmaps
+
+__all__ = ["calc_pg_upmaps"]

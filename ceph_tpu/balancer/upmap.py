@@ -1,0 +1,529 @@
+"""Upmap balancer — calc_pg_upmaps with TPU-batched cluster mapping.
+
+Semantics port of the reference's greedy optimizer
+(`OSDMap::calc_pg_upmaps`, reference src/osd/OSDMap.cc:4634-5208, with
+`try_pg_upmap` :4590 and `CrushWrapper::try_remap_rule` /
+`_choose_type_stack` at reference src/crush/CrushWrapper.cc:4061/3845).
+
+The structure is the reference's: a host-side greedy loop that drops or adds
+`pg_upmap_items` pairs one tiny change at a time, accepting only changes
+that lower the PG-count deviation stddev.  The expensive part — mapping
+every PG of every pool to build `pgs_by_osd` — runs as the batched JAX
+pipeline (one XLA call per pool) instead of the reference's per-PG
+`pg_to_up_acting_osds` loop; everything after that is incremental set
+bookkeeping, so the TPU does the O(PGs) work and the host does the O(changes)
+work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.balancer.crush_analysis import (
+    get_parent_of_type,
+    get_rule_weight_osd_map,
+    subtree_contains,
+)
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.types import ITEM_NONE, RuleOp
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgId
+
+
+# -- try_remap_rule ---------------------------------------------------------
+
+def _choose_type_stack(
+    m,
+    stack: list[tuple[int, int]],
+    overfull: set[int],
+    underfull: list[int],
+    more_underfull: list[int],
+    orig: list[int],
+    ipos: list[int],
+    used: set[int],
+    w: list[int],
+    root_bucket: int,
+    ruleno: int,
+) -> list[int]:
+    """reference CrushWrapper.cc:3845-4058; ipos is the shared orig cursor
+    (a 1-list so the caller sees advancement)."""
+    crush = m.crush
+    cumulative_fanout = [0] * len(stack)
+    f = 1
+    for j in range(len(stack) - 1, -1, -1):
+        cumulative_fanout[j] = f
+        f *= stack[j][1]
+
+    # per-level buckets that contain >=1 underfull device
+    underfull_buckets: list[set[int]] = [set() for _ in range(len(stack) - 1)]
+    for osd in underfull:
+        item = osd
+        for j in range(len(stack) - 2, -1, -1):
+            type_ = stack[j][0]
+            item = get_parent_of_type(crush, item, type_, ruleno)
+            if not subtree_contains(crush, root_bucket, item):
+                continue
+            underfull_buckets[j].add(item)
+
+    for j in range(len(stack)):
+        type_, fanout = stack[j]
+        cum_fanout = cumulative_fanout[j]
+        o: list[int] = []
+        tmpi = ipos[0]
+        if ipos[0] >= len(orig):
+            break
+        for from_ in w:
+            leaves: list[set[int]] = [set() for _ in range(fanout)]
+            for pos in range(fanout):
+                if type_ > 0:
+                    item = get_parent_of_type(
+                        crush, orig[tmpi], type_, ruleno
+                    )
+                    o.append(item)
+                    n = cum_fanout
+                    while n > 0 and tmpi < len(orig):
+                        leaves[pos].add(orig[tmpi])
+                        tmpi += 1
+                        n -= 1
+                else:
+                    replaced = False
+                    if orig[ipos[0]] in overfull:
+                        for cand_list in (underfull, more_underfull):
+                            for item in cand_list:
+                                if item in used:
+                                    continue
+                                if not subtree_contains(crush, from_, item):
+                                    continue
+                                if item in orig:
+                                    continue
+                                o.append(item)
+                                used.add(item)
+                                replaced = True
+                                ipos[0] += 1
+                                break
+                            if replaced:
+                                break
+                    if not replaced:
+                        o.append(orig[ipos[0]])
+                        ipos[0] += 1
+                    if ipos[0] >= len(orig):
+                        break
+            if j + 1 < len(stack):
+                # swap buckets with overfull leaves but no underfull
+                # candidates for peers that do have some
+                for pos in range(fanout):
+                    if pos >= len(o):
+                        break
+                    if o[pos] in underfull_buckets[j]:
+                        continue
+                    if not any(osd in overfull for osd in leaves[pos]):
+                        continue
+                    for alt in sorted(underfull_buckets[j]):
+                        if alt in o:
+                            continue
+                        if j == 0 or get_parent_of_type(
+                            crush, o[pos], stack[j - 1][0], ruleno
+                        ) == get_parent_of_type(
+                            crush, alt, stack[j - 1][0], ruleno
+                        ):
+                            o[pos] = alt
+                            break
+            if ipos[0] >= len(orig):
+                break
+        w = o
+    return w
+
+
+def try_remap_rule(
+    m: OSDMap,
+    ruleno: int,
+    maxout: int,
+    overfull: set[int],
+    underfull: list[int],
+    more_underfull: list[int],
+    orig: list[int],
+) -> list[int] | None:
+    """reference CrushWrapper.cc:4061-4156."""
+    crush = m.crush
+    rule = crush.rules[ruleno]
+    w: list[int] = []
+    out: list[int] = []
+    ipos = [0]
+    used: set[int] = set()
+    type_stack: list[tuple[int, int]] = []
+    root_bucket = 0
+    for op, a1, a2 in rule.steps:
+        if op == RuleOp.TAKE:
+            w = [a1]
+            root_bucket = a1
+        elif op in (RuleOp.CHOOSELEAF_FIRSTN, RuleOp.CHOOSELEAF_INDEP):
+            numrep, type_ = a1, a2
+            if numrep <= 0:
+                numrep += maxout
+            type_stack.append((type_, numrep))
+            if type_ > 0:
+                type_stack.append((0, 1))
+            w = _choose_type_stack(
+                m, type_stack, overfull, underfull, more_underfull,
+                orig, ipos, used, w, root_bucket, ruleno,
+            )
+            type_stack = []
+        elif op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSE_INDEP):
+            numrep, type_ = a1, a2
+            if numrep <= 0:
+                numrep += maxout
+            type_stack.append((type_, numrep))
+        elif op == RuleOp.EMIT:
+            if type_stack:
+                w = _choose_type_stack(
+                    m, type_stack, overfull, underfull, more_underfull,
+                    orig, ipos, used, w, root_bucket, ruleno,
+                )
+                type_stack = []
+            out.extend(w)
+            w = []
+    return out
+
+
+def try_pg_upmap(
+    m: OSDMap,
+    pg: PgId,
+    overfull: set[int],
+    underfull: list[int],
+    more_underfull: list[int],
+    orig: list[int],
+) -> list[int] | None:
+    """reference OSDMap.cc:4590-4632."""
+    pool = m.get_pg_pool(pg.pool)
+    if pool is None:
+        return None
+    ruleno = mapper_ref.find_rule(
+        m.crush, pool.crush_rule, int(pool.type), pool.size
+    )
+    if ruleno < 0:
+        return None
+    if not any(osd in overfull for osd in orig):
+        return None
+    out = try_remap_rule(
+        m, ruleno, pool.size, overfull, underfull, more_underfull, orig
+    )
+    if out is None or out == orig:
+        return None
+    return out
+
+
+# -- calc_pg_upmaps ---------------------------------------------------------
+
+@dataclass
+class UpmapResult:
+    num_changed: int = 0
+    new_pg_upmap_items: dict = field(default_factory=dict)
+    old_pg_upmap_items: set = field(default_factory=set)
+    stddev: float = 0.0
+    max_deviation: float = 0.0
+
+
+def _build_pgs_by_osd(
+    m: OSDMap, only_pools, use_tpu: bool
+) -> tuple[dict[int, set], int]:
+    """Map every PG of every (selected) pool; the reference's per-PG loop
+    (OSDMap.cc:4652-4665) replaced by the batched pipeline."""
+    pgs_by_osd: dict[int, set] = {}
+    total_pgs = 0
+    for pool_id, pool in sorted(m.pools.items()):
+        if only_pools and pool_id not in only_pools:
+            continue
+        total_pgs += pool.size * pool.pg_num
+        if use_tpu:
+            from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+            up, _, _, _ = PoolMapper(m, pool_id).map_all()
+            for ps in range(pool.pg_num):
+                pg = PgId(pool_id, ps)
+                for osd in up[ps]:
+                    if osd != ITEM_NONE and osd >= 0:
+                        pgs_by_osd.setdefault(int(osd), set()).add(pg)
+        else:
+            for ps in range(pool.pg_num):
+                pg = PgId(pool_id, ps)
+                up, _, _, _ = m.pg_to_up_acting_osds(pg)
+                for osd in up:
+                    if osd != ITEM_NONE:
+                        pgs_by_osd.setdefault(osd, set()).add(pg)
+    return pgs_by_osd, total_pgs
+
+
+def calc_pg_upmaps(
+    m: OSDMap,
+    max_deviation: int = 5,
+    max_iter: int = 10,
+    only_pools: set[int] | None = None,
+    aggressive: bool = True,
+    local_fallback_retries: int = 100,
+    use_tpu: bool = True,
+    rng: np.random.Generator | None = None,
+) -> UpmapResult:
+    """Greedy upmap optimization; mutates m.pg_upmap_items.  Returns the
+    change set (the reference's pending_inc).  reference OSDMap.cc:4634."""
+    res = UpmapResult()
+    max_deviation = max(1, max_deviation)
+    only_pools = only_pools or set()
+    rng = rng or np.random.default_rng(0)
+
+    pgs_by_osd, total_pgs = _build_pgs_by_osd(m, only_pools, use_tpu)
+
+    # per-osd weight from the pools' crush rules
+    osd_weight: dict[int, float] = {}
+    osd_weight_total = 0.0
+    for pool_id, pool in sorted(m.pools.items()):
+        if only_pools and pool_id not in only_pools:
+            continue
+        ruleno = mapper_ref.find_rule(
+            m.crush, pool.crush_rule, int(pool.type), pool.size
+        )
+        if ruleno < 0:
+            continue
+        pmap = get_rule_weight_osd_map(m.crush, ruleno)
+        for osd, w in pmap.items():
+            adjusted = m.get_weightf(osd) * w if osd < m.max_osd else 0.0
+            if adjusted == 0.0:
+                continue
+            osd_weight[osd] = osd_weight.get(osd, 0.0) + adjusted
+            osd_weight_total += adjusted
+    for osd in osd_weight:
+        pgs_by_osd.setdefault(osd, set())
+    if osd_weight_total == 0 or max_iter <= 0:
+        return res
+    pgs_per_weight = total_pgs / osd_weight_total
+
+    # deviations
+    def deviations(pbo):
+        dev = {}
+        stddev = 0.0
+        maxdev = 0.0
+        for osd, pgs in pbo.items():
+            if osd not in osd_weight:
+                continue
+            target = osd_weight[osd] * pgs_per_weight
+            d = len(pgs) - target
+            dev[osd] = d
+            stddev += d * d
+            maxdev = max(maxdev, abs(d))
+        return dev, stddev, maxdev
+
+    # drop pgs for osds outside the weight map (not in any rule tree)
+    pgs_by_osd = {o: s for o, s in pgs_by_osd.items() if o in osd_weight}
+    osd_deviation, stddev, cur_max_deviation = deviations(pgs_by_osd)
+    res.stddev, res.max_deviation = stddev, cur_max_deviation
+    if cur_max_deviation <= max_deviation:
+        return res
+
+    skip_overfull = False
+    iter_left = max_iter
+    while iter_left > 0:
+        iter_left -= 1
+        by_dev = sorted(
+            osd_deviation.items(), key=lambda kv: (kv[1], kv[0])
+        )
+        overfull: set[int] = set()
+        more_overfull: set[int] = set()
+        underfull: list[int] = []
+        more_underfull: list[int] = []
+        for osd, d in reversed(by_dev):
+            if d <= 0:
+                break
+            if d > max_deviation:
+                overfull.add(osd)
+            else:
+                more_overfull.add(osd)
+        for osd, d in by_dev:
+            if d >= 0:
+                break
+            if d < -max_deviation:
+                underfull.append(osd)
+            else:
+                more_underfull.append(osd)
+        if not underfull and not overfull:
+            break
+        using_more_overfull = False
+        if not overfull and underfull:
+            overfull = more_overfull
+            using_more_overfull = True
+
+        to_skip: set = set()
+        local_fallback_retried = 0
+
+        while True:  # retry: label
+            to_unmap: set = set()
+            to_upmap: dict = {}
+            temp_pgs_by_osd = {o: set(s) for o, s in pgs_by_osd.items()}
+            found = False
+
+            # ---- overfull pass -------------------------------------------
+            if not (skip_overfull and underfull):
+                for osd, deviation in reversed(by_dev):
+                    if deviation < 0:
+                        break
+                    if not using_more_overfull and deviation <= max_deviation:
+                        break
+                    pgs = [
+                        pg for pg in sorted(pgs_by_osd.get(osd, ()))
+                        if pg not in to_skip
+                    ]
+                    if aggressive:
+                        rng.shuffle(pgs)  # equal (in)attention
+                    # 1) drop existing remaps INTO this overfull osd
+                    for pg in pgs:
+                        items = m.pg_upmap_items.get(pg)
+                        if items is None:
+                            continue
+                        new_items = []
+                        for frm, to in items:
+                            if to == osd:
+                                temp_pgs_by_osd.setdefault(
+                                    to, set()
+                                ).discard(pg)
+                                temp_pgs_by_osd.setdefault(
+                                    frm, set()
+                                ).add(pg)
+                            else:
+                                new_items.append((frm, to))
+                        if not new_items:
+                            to_unmap.add(pg)
+                            found = True
+                            break
+                        elif len(new_items) != len(items):
+                            to_upmap[pg] = new_items
+                            found = True
+                            break
+                    if found:
+                        break
+                    # 2) add a new remapping pair
+                    for pg in pgs:
+                        if pg in m.pg_upmap:
+                            continue
+                        pool = m.get_pg_pool(pg.pool)
+                        new_items = list(m.pg_upmap_items.get(pg, []))
+                        if len(new_items) >= pool.size:
+                            continue
+                        existing: set[int] = set()
+                        for frm, to in new_items:
+                            existing.add(frm)
+                            existing.add(to)
+                        # raw mapping including existing upmaps
+                        raw, _ = m._pg_to_raw_osds(pool, pg)
+                        orig = list(raw)
+                        m._apply_upmap(pool, pg, orig)
+                        out = try_pg_upmap(
+                            m, pg, overfull, underfull, more_underfull, orig
+                        )
+                        if out is None or len(out) != len(orig):
+                            continue
+                        pos, max_dev = -1, 0.0
+                        for i2 in range(len(out)):
+                            if orig[i2] == out[i2]:
+                                continue
+                            if (
+                                orig[i2] in existing
+                                or out[i2] in existing
+                            ):
+                                continue
+                            d = osd_deviation.get(orig[i2], 0.0)
+                            if d > max_dev:
+                                max_dev, pos = d, i2
+                        if pos != -1:
+                            frm, to = orig[pos], out[pos]
+                            temp_pgs_by_osd.setdefault(
+                                frm, set()
+                            ).discard(pg)
+                            temp_pgs_by_osd.setdefault(to, set()).add(pg)
+                            new_items.append((frm, to))
+                            to_upmap[pg] = new_items
+                            found = True
+                            break
+                    if found:
+                        break
+
+            # ---- underfull pass ------------------------------------------
+            if not found:
+                for osd, deviation in by_dev:
+                    if osd not in underfull:
+                        break
+                    if abs(deviation) < max_deviation:
+                        break
+                    candidates = [
+                        (pg, items)
+                        for pg, items in sorted(m.pg_upmap_items.items())
+                        if pg not in to_skip
+                        and (not only_pools or pg.pool in only_pools)
+                    ]
+                    if aggressive:
+                        rng.shuffle(candidates)
+                    for pg, items in candidates:
+                        new_items = []
+                        for frm, to in items:
+                            if frm == osd:
+                                temp_pgs_by_osd.setdefault(
+                                    to, set()
+                                ).discard(pg)
+                                temp_pgs_by_osd.setdefault(
+                                    frm, set()
+                                ).add(pg)
+                            else:
+                                new_items.append((frm, to))
+                        if not new_items:
+                            to_unmap.add(pg)
+                            found = True
+                            break
+                        elif len(new_items) != len(items):
+                            to_upmap[pg] = new_items
+                            found = True
+                            break
+                    if found:
+                        break
+
+            if not found:
+                if not aggressive:
+                    iter_left = 0
+                elif not skip_overfull:
+                    iter_left = 0
+                else:
+                    skip_overfull = False
+                break  # out of retry loop
+
+            # ---- test_change ---------------------------------------------
+            temp_dev, new_stddev, cur_max_deviation = deviations(
+                temp_pgs_by_osd
+            )
+            if new_stddev >= stddev:
+                if not aggressive:
+                    iter_left = 0
+                    break
+                local_fallback_retried += 1
+                if local_fallback_retried >= local_fallback_retries:
+                    skip_overfull = not skip_overfull
+                    break
+                to_skip |= to_unmap
+                to_skip |= set(to_upmap)
+                continue  # goto retry
+
+            stddev = new_stddev
+            pgs_by_osd = temp_pgs_by_osd
+            osd_deviation = temp_dev
+            for pg in to_unmap:
+                del m.pg_upmap_items[pg]
+                res.old_pg_upmap_items.add(pg)
+                res.num_changed += 1
+            for pg, items in to_upmap.items():
+                m.pg_upmap_items[pg] = items
+                res.new_pg_upmap_items[pg] = items
+                res.num_changed += 1
+            res.stddev = stddev
+            res.max_deviation = cur_max_deviation
+            if cur_max_deviation <= max_deviation:
+                iter_left = 0
+            break  # exit retry loop, next outer iteration
+
+    return res

@@ -1,0 +1,9 @@
+from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
+from ceph_tpu.ec.registry import create_erasure_code, list_plugins
+
+__all__ = [
+    "ErasureCode",
+    "ErasureCodeProfileError",
+    "create_erasure_code",
+    "list_plugins",
+]

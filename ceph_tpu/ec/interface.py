@@ -1,0 +1,172 @@
+"""Erasure-code interface + shared base logic.
+
+Python-native equivalent of the reference's plugin surface
+(`ErasureCodeInterface`, reference src/erasure-code/ErasureCodeInterface.h:
+170-462) and the shared base class (`ErasureCode`, reference
+src/erasure-code/ErasureCode.{h,cc}): profile parsing, chunk-size/alignment
+math, `encode_prepare` split+pad, trivial `minimum_to_decode` (first k
+available, reference src/erasure-code/ErasureCode.cc:103-120), and the
+encode/decode driver loops.  Buffers are numpy uint8 arrays (bytes in/out at
+the API edge); the heavy per-stripe math is delegated to a backend engine
+(host numpy or the TPU path in ec.jax_backend).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SIMD_ALIGN = 32  # reference src/erasure-code/ErasureCode.cc:42
+
+
+class ErasureCodeProfileError(ValueError):
+    pass
+
+
+def _get_int(profile: dict, key: str, default: int) -> int:
+    v = profile.get(key, default)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise ErasureCodeProfileError(f"{key}={v!r} is not an integer")
+
+
+class ErasureCode:
+    """Base code: systematic, chunked; subclasses fill k/m and the chunk
+    math.  Mirrors the reference base-class semantics the OSD/benchmark
+    depend on."""
+
+    def __init__(self):
+        self.k = 0
+        self.m = 0
+        self.w = 8
+        self.chunk_mapping: list[int] = []
+        self.profile: dict = {}
+
+    # -- profile -----------------------------------------------------------
+    def init(self, profile: dict) -> None:
+        self.profile = dict(profile)
+        self.parse(profile)
+
+    def parse(self, profile: dict) -> None:
+        self.k = _get_int(profile, "k", self.k or 2)
+        self.m = _get_int(profile, "m", self.m or 1)
+        self.w = _get_int(profile, "w", 8)
+        if self.k < 1:
+            raise ErasureCodeProfileError(f"k={self.k} must be >= 1")
+        if self.m < 1:
+            raise ErasureCodeProfileError(f"m={self.m} must be >= 1")
+        mapping = profile.get("mapping")
+        if mapping:
+            # 'D' positions first (data), then the rest, in order
+            # (reference src/erasure-code/ErasureCode.cc to_mapping)
+            self.chunk_mapping = [
+                i for i, c in enumerate(mapping) if c == "D"
+            ] + [i for i, c in enumerate(mapping) if c != "D"]
+
+    # -- geometry ----------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_coding_chunk_count(self) -> int:
+        return self.m
+
+    def get_sub_chunk_count(self) -> int:
+        return 1  # array codes (clay) override
+
+    def get_alignment(self) -> int:
+        # jerasure reed_sol_van: k * w * sizeof(int)
+        return self.k * self.w * 4
+
+    def get_chunk_size(self, object_size: int) -> int:
+        """Pad object to `alignment`, split into k (reference jerasure
+        get_chunk_size semantics)."""
+        alignment = self.get_alignment()
+        tail = object_size % alignment
+        padded = object_size + (alignment - tail if tail else 0)
+        return padded // self.k
+
+    # -- mapping -----------------------------------------------------------
+    def chunk_index(self, i: int) -> int:
+        return self.chunk_mapping[i] if self.chunk_mapping else i
+
+    # -- minimum sets ------------------------------------------------------
+    def _minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        """First-k-available rule (reference ErasureCode.cc:103-120)."""
+        if want_to_read <= available:
+            return set(want_to_read)
+        if len(available) < self.k:
+            raise ValueError(
+                f"need {self.k} chunks, only {len(available)} available"
+            )
+        return set(sorted(available)[: self.k])
+
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        return self._minimum_to_decode(want_to_read, available)
+
+    def minimum_to_decode_with_cost(
+        self, want_to_read: set[int], available: dict[int, int]
+    ) -> set[int]:
+        """Cost-blind base version (reference ErasureCode.cc:122-133)."""
+        return self.minimum_to_decode(want_to_read, set(available))
+
+    # -- encode ------------------------------------------------------------
+    def encode_prepare(self, data: bytes | np.ndarray) -> np.ndarray:
+        """Split+zero-pad into k rows of chunk_size (reference
+        ErasureCode.cc:151-186 encode_prepare)."""
+        buf = np.frombuffer(bytes(data), np.uint8)
+        cs = self.get_chunk_size(len(buf))
+        out = np.zeros((self.k, cs), np.uint8)
+        flat = out.reshape(-1)
+        flat[: len(buf)] = buf
+        return out
+
+    def encode(
+        self, want_to_encode: set[int], data: bytes | np.ndarray
+    ) -> dict[int, np.ndarray]:
+        chunks = self.encode_prepare(data)
+        encoded = self.encode_chunks(chunks)
+        return {i: encoded[i] for i in want_to_encode}
+
+    def encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        """[k, cs] data rows -> [k+m, cs] all chunks."""
+        raise NotImplementedError
+
+    # -- decode ------------------------------------------------------------
+    def decode(
+        self,
+        want_to_read: set[int],
+        chunks: dict[int, np.ndarray],
+        chunk_size: int | None = None,
+    ) -> dict[int, np.ndarray]:
+        """reference ErasureCode.cc _decode: trivial path if all present,
+        else delegate to decode_chunks."""
+        if want_to_read <= set(chunks):
+            return {i: np.asarray(chunks[i], np.uint8) for i in want_to_read}
+        if chunk_size is None:
+            chunk_size = len(next(iter(chunks.values())))
+        full = self.decode_chunks(want_to_read, chunks, chunk_size)
+        return {i: full[i] for i in want_to_read}
+
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks: dict[int, np.ndarray],
+        chunk_size: int,
+    ) -> dict[int, np.ndarray]:
+        raise NotImplementedError
+
+    def decode_concat(self, chunks: dict[int, np.ndarray]) -> bytes:
+        """Reassemble the original object bytes from data chunks
+        (reference ErasureCode.cc decode_concat)."""
+        want = set(range(self.k))
+        out = self.decode(want, chunks)
+        return b"".join(
+            out[i].tobytes() for i in range(self.k)
+        )

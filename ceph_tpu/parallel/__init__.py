@@ -1,0 +1,6 @@
+from ceph_tpu.parallel.sharded import (
+    ShardedClusterMapper,
+    make_mesh,
+)
+
+__all__ = ["ShardedClusterMapper", "make_mesh"]

@@ -1,0 +1,176 @@
+"""PG-axis data parallelism over a jax device Mesh.
+
+The reference scales batch placement by sharding pgid ranges over a thread
+pool (ParallelPGMapper, reference src/osd/OSDMapMapping.h:18-140) and merges
+per-shard results under a lock.  The TPU-native equivalent: shard the PG axis
+of the batched pipeline over a `jax.sharding.Mesh` with `shard_map`, keep the
+(small) map tensors replicated, and reduce the per-OSD statistics with
+`psum` over ICI — no locks, no merge pass, one XLA program.
+
+This module also carries the cluster "step" used for rebalancing: map every
+PG, histogram PGs/primaries per OSD (the stats of osdmaptool
+--test-map-pgs, reference src/tools/osdmaptool.cc:696-754), and produce a
+crush-compat style multiplicative weight adjustment from the deviation — one
+iteration of the balancer's outer loop, fully on-device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+PG_AXIS = "pg"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
+    """1-D mesh over the first n devices; the PG axis shards over it.
+
+    (The placement workload has a single giant data axis — see SURVEY's
+    parallelism inventory; there is no tensor/pipeline dimension to shard,
+    so the mesh is 1-D by design.)
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(devs)} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+        )
+    return Mesh(np.array(devs[:n_devices]), (axis,))
+
+
+def _hist(ids, n, extra_mask=None):
+    """Per-OSD counts via scatter-add; invalid lanes (ITEM_NONE pads and
+    -1 no-primary markers) fall off the end."""
+    valid = (ids != ITEM_NONE) & (ids >= 0)
+    if extra_mask is not None:
+        valid = valid & extra_mask
+    idx = jnp.where(valid, jnp.clip(ids, 0, n - 1), n)
+    return jnp.zeros(n + 1, jnp.int32).at[idx.reshape(-1)].add(1)[:n]
+
+
+class ShardedClusterMapper:
+    """Batched pool mapping + cluster stats, sharded over a device mesh.
+
+    Usage:
+        mesh = make_mesh()
+        scm = ShardedClusterMapper(osdmap, pool_id, mesh)
+        out = scm.map_stats()          # mapping + per-OSD histograms
+        st  = scm.rebalance_step(w)    # one on-device balancer iteration
+    """
+
+    def __init__(self, m, pool_id: int, mesh: Mesh):
+        self.mesh = mesh
+        self.pm = PoolMapper(m, pool_id, overlays=False)
+        self.n_dev_total = mesh.devices.size
+        self.DV = int(self.pm.dev["weight"].shape[0])
+        self.pg_num = self.pm.spec.pg_num
+        # pad the PG axis to a multiple of the mesh size
+        n = self.n_dev_total
+        self.pg_padded = ((self.pg_num + n - 1) // n) * n
+        self._jit_map = None
+        self._jit_step = None
+
+    # -- sharded mapping + stats ------------------------------------------
+    def _build_map_fn(self):
+        fn, DV, pg_num = self.pm.fn, self.DV, self.pg_num
+        vf = jax.vmap(fn, in_axes=(0, None, 0))
+        axis = self.mesh.axis_names[0]
+
+        def local(ps, dev):
+            up, upp, acting, actp = vf(ps, dev, {})
+            live = ps < pg_num  # padding rows don't count
+            hist = _hist(acting, DV, live[:, None])
+            phist = _hist(actp[:, None], DV, live[:, None])
+            fhist = _hist(acting[:, :1], DV, live[:, None])
+            hist = jax.lax.psum(hist, axis)
+            phist = jax.lax.psum(phist, axis)
+            fhist = jax.lax.psum(fhist, axis)
+            return up, upp, acting, actp, hist, phist, fhist
+
+        sm = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sm)
+
+    def _ps(self):
+        ps = np.arange(self.pg_padded, dtype=np.uint32)
+        sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        return jax.device_put(ps, sh)
+
+    def map_stats(self):
+        """Map all PGs; returns dict with per-PG mappings (device-sharded)
+        and replicated per-OSD histograms (count / primary / first)."""
+        if self._jit_map is None:
+            self._jit_map = self._build_map_fn()
+        up, upp, acting, actp, hist, phist, fhist = self._jit_map(
+            self._ps(), self.pm.dev
+        )
+        return {
+            "up": up, "up_primary": upp,
+            "acting": acting, "acting_primary": actp,
+            "pgs_per_osd": hist,
+            "primary_per_osd": phist,
+            "first_per_osd": fhist,
+        }
+
+    # -- one balancer iteration, fully on device ---------------------------
+    def _build_step_fn(self):
+        fn, DV, pg_num = self.pm.fn, self.DV, self.pg_num
+        R = self.pm.spec.size
+        vf = jax.vmap(fn, in_axes=(0, None, 0))
+        axis = self.mesh.axis_names[0]
+
+        def local(ps, dev):
+            _, _, acting, _ = vf(ps, dev, {})
+            live = ps < pg_num
+            hist = jax.lax.psum(_hist(acting, DV, live[:, None]), axis)
+            # weight-proportional target (reference src/osd/OSDMap.cc:
+            # 4707-4732 deviation build): target_i = pgs*R * w_i / sum(w)
+            w = dev["weight"].astype(jnp.float32)
+            tw = jnp.sum(w)
+            target = (pg_num * R) * w / jnp.maximum(tw, 1.0)
+            dev_f = hist.astype(jnp.float32) - target
+            stddev = jnp.sqrt(
+                jnp.sum(dev_f * dev_f) / jnp.maximum(jnp.sum(w > 0), 1)
+            )
+            # crush-compat style multiplicative correction on the 16.16
+            # weights (the choose_args weight-set update of the balancer's
+            # crush-compat mode, reference pybind/mgr/balancer/module.py:90)
+            ratio = target / jnp.maximum(hist.astype(jnp.float32), 1.0)
+            ratio = jnp.clip(ratio, 0.5, 2.0)
+            new_w = jnp.where(
+                (w > 0) & (target > 0),
+                jnp.clip(w * ratio, 1.0, None),
+                w,
+            ).astype(jnp.uint32)
+            return new_w, stddev, hist
+
+        sm = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sm)
+
+    def rebalance_step(self, weights=None):
+        """One balancer iteration: map→histogram→deviation→weight update.
+        Returns (new_weight u32[DV], stddev, pgs_per_osd)."""
+        if self._jit_step is None:
+            self._jit_step = self._build_step_fn()
+        dev = dict(self.pm.dev)
+        if weights is not None:
+            dev["weight"] = jnp.asarray(weights, jnp.uint32)
+        return self._jit_step(self._ps(), dev)

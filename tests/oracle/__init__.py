@@ -84,6 +84,12 @@ def load():
     lib.oracle_set_choose_args.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint),
     ]
+    lib.oracle_bench_rule.restype = ctypes.c_longlong
+    lib.oracle_bench_rule.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_uint),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+    ]
     lib.oracle_hash32_2.restype = ctypes.c_uint
     lib.oracle_hash32_2.argtypes = [ctypes.c_uint, ctypes.c_uint]
     lib.oracle_hash32_3.restype = ctypes.c_uint
@@ -138,6 +144,16 @@ class OracleMap:
         n = self.lib.oracle_do_rule(self.h, ruleno, int(x) & 0xFFFFFFFF, res,
                                     result_max, wa, wn)
         return [res[i] for i in range(n)]
+
+    def bench_rule(self, ruleno, x0, n, pool, weights, result_max):
+        """Time n do_rule calls in C; returns (elapsed_ns, checksum)."""
+        wa = (ctypes.c_uint * len(weights))(*[int(w) for w in weights])
+        sink = ctypes.c_longlong(0)
+        ns = self.lib.oracle_bench_rule(
+            self.h, ruleno, int(x0) & 0xFFFFFFFF, n, pool, result_max,
+            wa, len(weights), ctypes.byref(sink),
+        )
+        return ns, sink.value
 
     def __del__(self):
         try:

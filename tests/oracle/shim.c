@@ -123,6 +123,40 @@ int oracle_do_rule(void *vo, int ruleno, int x, int *result, int result_max,
     return n;
 }
 
+/* Single-core benchmark loop: time n crush_do_rule calls (x = x0..x0+n-1,
+ * each pre-mixed with crush_hash32_2(x, pool) like CrushTester's --pool_id
+ * path, reference src/crush/CrushTester.cc:612-623) entirely in C so the
+ * baseline measures the reference kernel, not ctypes.  Returns elapsed
+ * nanoseconds; *sink accumulates results to defeat dead-code elimination. */
+#include <time.h>
+long long oracle_bench_rule(void *vo, int ruleno, unsigned x0, int n,
+                            int pool, int result_max, const unsigned *weight,
+                            int weight_max, long long *sink) {
+    struct oracle *o = vo;
+    if (!o->map->working_size)
+        crush_finalize(o->map);
+    char *work = malloc(o->map->working_size + 3 * result_max * sizeof(int));
+    int *result = malloc(result_max * sizeof(int));
+    long long acc = 0;
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    for (int i = 0; i < n; i++) {
+        unsigned x = crush_hash32_2(CRUSH_HASH_RJENKINS1, x0 + i,
+                                    (unsigned)pool);
+        crush_init_workspace(o->map, work);
+        int c = crush_do_rule(o->map, ruleno, x, result, result_max, weight,
+                              weight_max, work, o->choose_args);
+        for (int j = 0; j < c; j++)
+            acc += result[j];
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    free(result);
+    free(work);
+    if (sink)
+        *sink = acc;
+    return (t1.tv_sec - t0.tv_sec) * 1000000000LL + (t1.tv_nsec - t0.tv_nsec);
+}
+
 unsigned oracle_hash32_2(unsigned a, unsigned b) {
     return crush_hash32_2(CRUSH_HASH_RJENKINS1, a, b);
 }

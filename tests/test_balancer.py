@@ -1,0 +1,181 @@
+"""Upmap balancer tests — property-based, after the reference's
+TestOSDMap upmap cases (reference src/test/osd/TestOSDMap.cc:622-790):
+build synthetic unbalanced maps, run calc_pg_upmaps, check that the
+produced pg_upmap_items are valid and the distribution improves."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.balancer import calc_pg_upmaps
+from ceph_tpu.balancer.crush_analysis import (
+    get_parent_of_type,
+    get_rule_weight_osd_map,
+    subtree_contains,
+)
+from ceph_tpu.balancer.upmap import try_remap_rule
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.osdmap import build_hierarchical
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+
+
+def _map(n_host=4, per=4, pg_num=128, size=3):
+    pool = PgPool(
+        type=PoolType.REPLICATED, size=size, crush_rule=0,
+        pg_num=pg_num, pgp_num=pg_num,
+    )
+    return build_hierarchical(n_host, per, pool=pool)
+
+
+def _pg_counts(m, pool_id=0):
+    counts = {}
+    pool = m.pools[pool_id]
+    for ps in range(pool.pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(PgId(pool_id, ps))
+        for o in up:
+            if o != ITEM_NONE:
+                counts[o] = counts.get(o, 0) + 1
+    return counts
+
+
+class TestCrushAnalysis:
+    def test_subtree_and_parent(self):
+        m = _map()
+        crush = m.crush
+        by_name = {v: k for k, v in crush.item_names.items()}
+        host0 = by_name["host0"]
+        root = by_name["default"]
+        assert subtree_contains(crush, root, 0)
+        assert subtree_contains(crush, host0, 0)
+        assert not subtree_contains(crush, host0, 5)
+        assert get_parent_of_type(crush, 0, 1, 0) == host0
+        assert get_parent_of_type(crush, 0, 1) == host0
+
+    def test_rule_weight_map(self):
+        m = _map(n_host=2, per=2)
+        pmap = get_rule_weight_osd_map(m.crush, 0)
+        assert set(pmap) == {0, 1, 2, 3}
+        assert all(abs(v - 0.25) < 1e-6 for v in pmap.values())
+
+
+class TestTryRemapRule:
+    def test_swaps_overfull_for_underfull(self):
+        m = _map(n_host=4, per=2)
+        # orig maps to osds 0,2,4 (hosts 0,1,2); evacuate 0 -> want 6 or 7
+        out = try_remap_rule(
+            m, 0, 3, overfull={0}, underfull=[6], more_underfull=[],
+            orig=[0, 2, 4],
+        )
+        assert out == [6, 2, 4]
+
+    def test_respects_failure_domain(self):
+        m = _map(n_host=4, per=2)
+        # 3 is on host1 which already hosts 2: replacement must come from
+        # the same chooseleaf subtree walk, so 2->? can't land on host of 4
+        out = try_remap_rule(
+            m, 0, 3, overfull={2}, underfull=[3], more_underfull=[],
+            orig=[0, 2, 4],
+        )
+        # 3 shares host with 2: still a valid swap (same subtree)
+        assert out == [0, 3, 4]
+
+    def test_no_op_when_no_overfull_in_orig(self):
+        m = _map(n_host=4, per=2)
+        out = try_remap_rule(
+            m, 0, 3, overfull={7}, underfull=[6], more_underfull=[],
+            orig=[0, 2, 4],
+        )
+        assert out == [0, 2, 4]
+
+
+def _assert_valid_upmaps(m, pool_id=0):
+    pool = m.pools[pool_id]
+    for pg, items in m.pg_upmap_items.items():
+        assert pg.pool == pool_id and pg.seed < pool.pg_num
+        for frm, to in items:
+            assert 0 <= to < m.max_osd and m.exists(to)
+    # mappings stay duplicate-free and full-size
+    for ps in range(pool.pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(PgId(pool_id, ps))
+        real = [o for o in up if o != ITEM_NONE]
+        assert len(real) == len(set(real)) == pool.size
+
+
+class TestCalcPgUpmaps:
+    @staticmethod
+    def _sq_dev_vs_target(m):
+        """Sum of squared deviations from the weight-proportional target —
+        the objective calc_pg_upmaps minimizes (OSDMap.cc:4707-4732)."""
+        pmap = get_rule_weight_osd_map(m.crush, 0)
+        total_w = sum(
+            m.get_weightf(o) * w for o, w in pmap.items()
+        )
+        pool = m.pools[0]
+        total_pgs = pool.size * pool.pg_num
+        counts = _pg_counts(m)
+        s = 0.0
+        for o, w in pmap.items():
+            target = m.get_weightf(o) * w / total_w * total_pgs
+            d = counts.get(o, 0) - target
+            s += d * d
+        return s
+
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    @pytest.mark.parametrize("skewed", [False, True])
+    def test_balances_cluster(self, use_tpu, skewed):
+        if use_tpu and skewed:
+            pytest.skip("same code path as use_tpu+uniform")
+        pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                      pg_num=256, pgp_num=256)
+        wf = (lambda o: 0x20000 if o < 4 else 0x10000) if skewed else None
+        m = build_hierarchical(4, 4, pool=pool, weight_fn=wf)
+        dev_before = self._sq_dev_vs_target(m)
+        res = calc_pg_upmaps(
+            m, max_deviation=1, max_iter=20, use_tpu=use_tpu,
+            rng=np.random.default_rng(42),
+        )
+        dev_after = self._sq_dev_vs_target(m)
+        _assert_valid_upmaps(m)
+        if res.num_changed:
+            assert dev_after < dev_before
+        assert res.stddev >= 0
+
+    def test_converges_and_is_stable(self):
+        m = _map(n_host=4, per=4, pg_num=256)
+        r1 = calc_pg_upmaps(
+            m, max_deviation=1, max_iter=50, use_tpu=False,
+            rng=np.random.default_rng(1),
+        )
+        # second run from the balanced state should do (almost) nothing
+        r2 = calc_pg_upmaps(
+            m, max_deviation=1, max_iter=50, use_tpu=False,
+            rng=np.random.default_rng(2),
+        )
+        _assert_valid_upmaps(m)
+        assert r2.num_changed <= max(2, r1.num_changed // 4)
+
+    def test_already_perfect_returns_zero(self):
+        m = _map(n_host=4, per=4, pg_num=256)
+        res = calc_pg_upmaps(m, max_deviation=100, use_tpu=False)
+        assert res.num_changed == 0
+
+    def test_batched_pipeline_agrees_after_balancing(self):
+        """The TPU overlay path must reproduce the balanced mapping."""
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        m = _map(n_host=4, per=4, pg_num=128)
+        calc_pg_upmaps(
+            m, max_deviation=1, max_iter=20, use_tpu=False,
+            rng=np.random.default_rng(3),
+        )
+        if not m.pg_upmap_items:
+            pytest.skip("balancer made no changes on this map")
+        pm = PoolMapper(m, 0)
+        up, upp, acting, actp = pm.map_all()
+        pool = m.pools[0]
+        for ps in range(pool.pg_num):
+            w_up, w_upp, w_act, w_actp = m.pg_to_up_acting_osds(
+                PgId(0, ps)
+            )
+            got = [o for o in up[ps] if o != ITEM_NONE]
+            assert got == w_up, f"ps={ps}"
+            assert upp[ps] == w_upp

@@ -1,0 +1,228 @@
+"""Erasure-code tests: field properties, matrix constructions, roundtrip
+grids (the TestErasureCode* pattern of the reference,
+reference src/test/erasure-code/TestErasureCode.cc etc.), and
+host-vs-device engine parity."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import create_erasure_code
+from ceph_tpu.ec.gf import (
+    GF_EXP,
+    GF_MUL_TABLE,
+    gf_div,
+    gf_inv,
+    gf_invert_matrix,
+    gf_matmul,
+    gf_mul,
+    gf_pow,
+    matrix_to_bitmatrix,
+)
+from ceph_tpu.ec import matrices
+from ceph_tpu.ec.interface import ErasureCodeProfileError
+
+
+class TestGF:
+    def test_mul_table_vs_peasant(self):
+        """Table multiply == carry-less peasant multiply mod 0x11D."""
+
+        def slow(a, b):
+            p = 0
+            while b:
+                if b & 1:
+                    p ^= a
+                a <<= 1
+                if a & 0x100:
+                    a ^= 0x11D
+                b >>= 1
+            return p
+
+        rng = np.random.default_rng(7)
+        for a, b in rng.integers(0, 256, (500, 2)):
+            assert GF_MUL_TABLE[a, b] == slow(int(a), int(b))
+
+    def test_field_axioms_sampled(self):
+        rng = np.random.default_rng(8)
+        a, b, c = rng.integers(1, 256, 3)
+        assert gf_mul(a, b) == gf_mul(b, a)
+        assert gf_mul(a, gf_mul(b, c)) == gf_mul(gf_mul(a, b), c)
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf_mul(a, gf_inv(a)) == 1
+        with pytest.raises(ZeroDivisionError):
+            gf_inv(0)
+
+    def test_div_pow(self):
+        assert gf_div(gf_mul(7, 9), 9) == 7
+        assert gf_pow(2, 8) == GF_EXP[8]
+        assert gf_pow(5, 0) == 1
+        assert gf_pow(0, 3) == 0
+
+    def test_matrix_inversion(self):
+        rng = np.random.default_rng(9)
+        for _ in range(20):
+            M = rng.integers(0, 256, (5, 5)).astype(np.uint8)
+            try:
+                inv = gf_invert_matrix(M)
+            except np.linalg.LinAlgError:
+                continue
+            eye = gf_matmul(M, inv)
+            assert np.array_equal(eye, np.eye(5, dtype=np.uint8))
+
+    def test_bitmatrix_is_multiplication(self):
+        rng = np.random.default_rng(10)
+        for c in rng.integers(0, 256, 16):
+            B = matrix_to_bitmatrix(np.array([[c]], np.uint8))
+            for x in rng.integers(0, 256, 8):
+                bits = np.array([(int(x) >> i) & 1 for i in range(8)])
+                y_bits = B @ bits % 2
+                y = sum(int(v) << i for i, v in enumerate(y_bits))
+                assert y == GF_MUL_TABLE[c, x]
+
+
+KM_GRID = [(2, 1), (2, 2), (3, 2), (4, 2), (4, 3), (6, 2), (6, 3), (8, 4)]
+
+
+class TestMatrices:
+    @pytest.mark.parametrize("k,m", KM_GRID)
+    def test_vandermonde_mds(self, k, m):
+        C = matrices.vandermonde_rs(k, m)
+        assert np.all(C[0] == 1)  # first parity row = XOR row
+        assert matrices.is_mds(C)
+
+    @pytest.mark.parametrize("k,m", [(3, 2), (4, 2), (5, 3), (8, 4)])
+    def test_cauchy_mds(self, k, m):
+        assert matrices.is_mds(matrices.cauchy_orig(k, m))
+        good = matrices.cauchy_good(k, m)
+        assert np.all(good[0] == 1)
+        assert matrices.is_mds(good)
+
+    @pytest.mark.parametrize("k,m", [(3, 2), (4, 2), (8, 4)])
+    def test_isa_cauchy_mds(self, k, m):
+        assert matrices.is_mds(matrices.isa_cauchy(k, m))
+
+    def test_r6(self):
+        C = matrices.rs_r6(5)
+        assert np.all(C[0] == 1)
+        assert matrices.is_mds(C)
+
+    def test_recover_matrix_identity_when_present(self):
+        C = matrices.vandermonde_rs(4, 2)
+        R = matrices.recover_matrix(C, [0, 1, 2, 3], [0, 1, 2, 3])
+        assert np.array_equal(R, np.eye(4, dtype=np.uint8))
+
+
+def _roundtrip(code, k, m, rng, nbytes=1237):
+    data = rng.integers(0, 256, nbytes).astype(np.uint8).tobytes()
+    n = k + m
+    encoded = code.encode(set(range(n)), data)
+    cs = code.get_chunk_size(nbytes)
+    assert all(len(encoded[i]) == cs for i in encoded)
+    # every erasure pattern up to m losses must decode bit-exactly
+    for lost_n in range(1, m + 1):
+        for lost in itertools.combinations(range(n), lost_n):
+            have = {i: encoded[i] for i in range(n) if i not in lost}
+            got = code.decode(set(range(k)), dict(have))
+            out = b"".join(got[i].tobytes() for i in range(k))
+            assert out[:nbytes] == data, f"lost={lost}"
+
+
+class TestRoundtrip:
+    @pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (8, 4)])
+    @pytest.mark.parametrize(
+        "technique",
+        ["reed_sol_van", "cauchy_orig", "cauchy_good"],
+    )
+    def test_jerasure(self, k, m, technique, rng):
+        code = create_erasure_code(
+            {"plugin": "jerasure", "technique": technique,
+             "k": k, "m": m}
+        )
+        _roundtrip(code, k, m, rng)
+
+    @pytest.mark.parametrize("k", [3, 6])
+    def test_r6(self, k, rng):
+        code = create_erasure_code(
+            {"plugin": "jerasure", "technique": "reed_sol_r6_op",
+             "k": k, "m": 2}
+        )
+        _roundtrip(code, k, 2, rng)
+
+    @pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy"])
+    def test_isa(self, technique, rng):
+        code = create_erasure_code(
+            {"plugin": "isa", "technique": technique, "k": 4, "m": 2}
+        )
+        _roundtrip(code, 4, 2, rng)
+
+    def test_example_xor(self, rng):
+        code = create_erasure_code({"plugin": "example", "k": 3, "m": 1})
+        _roundtrip(code, 3, 1, rng)
+
+
+class TestInterface:
+    def test_chunk_size_alignment(self):
+        code = create_erasure_code({"plugin": "jerasure", "k": 4, "m": 2})
+        cs = code.get_chunk_size(1000)
+        align = code.get_alignment()
+        assert (cs * 4) % align == 0 and cs * 4 >= 1000
+
+    def test_minimum_to_decode(self):
+        code = create_erasure_code({"plugin": "jerasure", "k": 3, "m": 2})
+        # all wanted available -> want itself
+        assert code.minimum_to_decode({0, 1}, {0, 1, 2, 4}) == {0, 1}
+        # otherwise first k available
+        assert code.minimum_to_decode({0, 1, 2}, {1, 2, 3, 4}) == {1, 2, 3}
+        with pytest.raises(ValueError):
+            code.minimum_to_decode({0}, {1, 2})
+
+    def test_bad_profiles(self):
+        with pytest.raises(ErasureCodeProfileError):
+            create_erasure_code({"plugin": "nope"})
+        with pytest.raises(ErasureCodeProfileError):
+            create_erasure_code({"plugin": "jerasure", "k": "x"})
+        with pytest.raises(ErasureCodeProfileError):
+            create_erasure_code(
+                {"plugin": "jerasure", "technique": "wat"}
+            )
+        with pytest.raises(ErasureCodeProfileError):
+            create_erasure_code({"plugin": "jerasure", "k": 0})
+
+    def test_decode_concat(self, rng):
+        code = create_erasure_code({"plugin": "jerasure", "k": 3, "m": 2})
+        data = rng.integers(0, 256, 500).astype(np.uint8).tobytes()
+        enc = code.encode(set(range(5)), data)
+        del enc[1], enc[3]
+        assert code.decode_concat(enc)[:500] == data
+
+
+class TestJaxEngine:
+    @pytest.mark.parametrize("strategy", ["logexp", "bitplane"])
+    def test_matches_numpy(self, strategy, rng):
+        from ceph_tpu.ec.jax_backend import JaxEngine
+        from ceph_tpu.ec.rs import NumpyEngine
+
+        M = matrices.vandermonde_rs(6, 3)
+        data = rng.integers(0, 256, (6, 4096)).astype(np.uint8)
+        want = NumpyEngine().matmul(M, data)
+        got = JaxEngine(strategy).matmul(M, data)
+        assert np.array_equal(want, got)
+
+    def test_bitplane_tiling(self, rng):
+        from ceph_tpu.ec.jax_backend import JaxEngine
+        from ceph_tpu.ec.rs import NumpyEngine
+
+        M = matrices.vandermonde_rs(4, 2)
+        data = rng.integers(0, 256, (4, 5000)).astype(np.uint8)
+        eng = JaxEngine("bitplane", tile=1024)  # force multi-tile + pad
+        assert np.array_equal(
+            eng.matmul(M, data), NumpyEngine().matmul(M, data)
+        )
+
+    def test_jax_plugin_roundtrip(self, rng):
+        code = create_erasure_code({"plugin": "jax", "k": 4, "m": 2})
+        _roundtrip(code, 4, 2, rng, nbytes=2000)
