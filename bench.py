@@ -48,6 +48,9 @@ def build_map():
 
 def bench_tpu(m) -> float:
     """Mappings/sec of the jitted batched pipeline (steady-state)."""
+    from ceph_tpu.utils import ensure_jax_backend
+
+    ensure_jax_backend()
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,375 @@
+"""osdmaptool — create/inspect/test osdmaps, batched on TPU.
+
+Covers the reference tool's standalone surface (reference
+src/tools/osdmaptool.cc:41-68 usage):
+
+    osdmaptool mapfile --createsimple N [--pg-bits B] [--pgp-bits B]
+    osdmaptool mapfile --create-from-conf-like  (hierarchical: --num-hosts)
+    osdmaptool mapfile --print
+    osdmaptool mapfile --test-map-pgs [--pool P] [--backend jax|ref]
+    osdmaptool mapfile --test-map-pgs-dump
+    osdmaptool mapfile --test-map-pgs-dump-all
+    osdmaptool mapfile --test-map-pg <pgid>
+    osdmaptool mapfile --mark-up-in
+    osdmaptool mapfile --upmap out.txt [--upmap-deviation D]
+                        [--upmap-max N] [--upmap-pool name]
+    osdmaptool mapfile --upmap-cleanup
+    osdmaptool mapfile --export-crush f / --import-crush f
+
+Map files are the framework's JSON osdmap format (ceph_tpu.osd.io); the
+stats output mirrors the reference's --test-map-pgs table
+(reference src/tools/osdmaptool.cc:630-755).
+
+The per-PG mapping loop runs as one batched XLA call per pool
+(`--backend jax`, default) or through the host oracle (`--backend ref`).
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import time
+
+import numpy as np
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.io import (
+    load_crush_text,
+    load_osdmap,
+    osdmap_to_dict,
+    save_crush_text,
+    save_osdmap,
+)
+from ceph_tpu.osd.osdmap import OSDMap, build_simple
+from ceph_tpu.osd.types import PgId
+
+
+def _vec(v) -> str:
+    return "[" + ",".join(str(int(o)) for o in v) + "]"
+
+
+def _crush_weightf_map(m: OSDMap) -> dict[int, float]:
+    """One pass over the (non-shadow) buckets: device -> crush weight."""
+    shadows = {
+        sid
+        for per in m.crush.class_bucket.values()
+        for sid in per.values()
+    }
+    out: dict[int, float] = {}
+    for bid, b in m.crush.buckets.items():
+        if bid in shadows:
+            continue
+        for it, w in zip(b.items, b.weights):
+            if it >= 0 and it not in out:
+                out[it] = w / 0x10000
+    return out
+
+
+def _map_pool(m: OSDMap, pool_id: int, backend: str):
+    """-> (acting[N,W], acting_primary[N], up[N,W], up_primary[N]) numpy."""
+    pool = m.pools[pool_id]
+    if backend == "jax":
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        up, upp, acting, actp = PoolMapper(m, pool_id).map_all()
+        return acting, actp, up, upp
+    n = pool.pg_num
+    W = pool.size
+    up = np.full((n, W), ITEM_NONE, np.int32)
+    upp = np.full(n, -1, np.int32)
+    acting = np.full((n, W), ITEM_NONE, np.int32)
+    actp = np.full(n, -1, np.int32)
+    for ps in range(n):
+        u, up_pr, a, a_pr = m.pg_to_up_acting_osds(PgId(pool_id, ps))
+        up[ps, : len(u)] = u
+        acting[ps, : len(a)] = a
+        upp[ps] = up_pr
+        actp[ps] = a_pr
+    return acting, actp, up, upp
+
+
+def test_map_pgs(
+    m: OSDMap,
+    only_pool: int = -1,
+    dump: str | None = None,
+    backend: str = "jax",
+    out=None,
+) -> None:
+    out = out or sys.stdout
+    n = m.max_osd
+    count = np.zeros(n, np.int64)
+    first_count = np.zeros(n, np.int64)
+    primary_count = np.zeros(n, np.int64)
+    sizes: dict[int, int] = {}
+    for pid in sorted(m.pools):
+        if only_pool != -1 and pid != only_pool:
+            continue
+        pool = m.pools[pid]
+        print(f"pool {pid} pg_num {pool.pg_num}", file=out)
+        acting, actp, up, upp = _map_pool(m, pid, backend)
+        for ps in range(pool.pg_num):
+            osds = [o for o in acting[ps] if o != ITEM_NONE]
+            sizes[len(osds)] = sizes.get(len(osds), 0) + 1
+            for o in osds:
+                count[o] += 1
+            if osds:
+                first_count[osds[0]] += 1
+            if actp[ps] >= 0:
+                primary_count[actp[ps]] += 1
+            if dump == "dump":
+                print(
+                    f"{pid}.{ps:x}\t{_vec(osds)}\t{actp[ps]}", file=out
+                )
+            elif dump == "dump_all":
+                raw = [o for o in up[ps] if o != ITEM_NONE]
+                print(
+                    f"{pid}.{ps:x} raw ({_vec(raw)}, p{upp[ps]}) "
+                    f"up ({_vec(raw)}, p{upp[ps]}) "
+                    f"acting ({_vec(osds)}, p{actp[ps]})",
+                    file=out,
+                )
+
+    total = 0
+    n_in = 0
+    min_osd = max_osd = -1
+    cwf = _crush_weightf_map(m)
+    print("#osd\tcount\tfirst\tprimary\tc wt\twt", file=out)
+    for i in range(n):
+        if not m.is_in(i):
+            continue
+        cw = cwf.get(i, 0.0)
+        if cw <= 0:
+            continue
+        n_in += 1
+        print(
+            f"osd.{i}\t{count[i]}\t{first_count[i]}\t{primary_count[i]}"
+            f"\t{cw:g}\t{m.get_weightf(i):g}",
+            file=out,
+        )
+        total += count[i]
+        if count[i] and (min_osd < 0 or count[i] < count[min_osd]):
+            min_osd = i
+        if count[i] and (max_osd < 0 or count[i] > count[max_osd]):
+            max_osd = i
+    avg = total // n_in if n_in else 0
+    dev = 0.0
+    for i in range(n):
+        if not m.is_in(i) or cwf.get(i, 0.0) <= 0:
+            continue
+        dev += float((avg - count[i]) ** 2)
+    dev = math.sqrt(dev / n_in) if n_in else 0.0
+    edev = (
+        math.sqrt(total / n_in * (1.0 - 1.0 / n_in)) if n_in else 0.0
+    )
+    print(f" in {n_in}", file=out)
+    if avg:
+        print(
+            f" avg {avg} stddev {dev:g} ({dev / avg:g}x) "
+            f"(expected {edev:g} {edev / avg:g}x))",
+            file=out,
+        )
+    if min_osd >= 0:
+        print(f" min osd.{min_osd} {count[min_osd]}", file=out)
+    if max_osd >= 0:
+        print(f" max osd.{max_osd} {count[max_osd]}", file=out)
+    for sz in sorted(sizes):
+        print(f"size {sz}\t{sizes[sz]}", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print("usage: osdmaptool <mapfile> [options]", file=sys.stderr)
+        return 1
+    mapfile = None
+    createsimple = 0
+    pg_bits, pgp_bits = 6, 6
+    do_print = False
+    mark_up_in = False
+    clobber = False
+    test_mode: str | None = None
+    test_pool = -1
+    backend = "jax"
+    upmap_file = None
+    upmap_deviation = 5
+    upmap_max = 10
+    upmap_pools: set[int] = set()
+    upmap_cleanup = False
+    export_crush = None
+    import_crush = None
+    test_map_pg = None
+
+    i = 0
+
+    def next_arg(what: str) -> str:
+        nonlocal i
+        i += 1
+        if i >= len(args):
+            print(f"missing argument for {what}", file=sys.stderr)
+            raise SystemExit(1)
+        return args[i]
+
+    pending_pool_names: list[str] = []
+    while i < len(args):
+        a = args[i]
+        if a == "--createsimple":
+            createsimple = int(next_arg(a))
+        elif a == "--pg-bits" or a == "--pg_bits":
+            pg_bits = int(next_arg(a))
+        elif a == "--pgp-bits" or a == "--pgp_bits":
+            pgp_bits = int(next_arg(a))
+        elif a == "--clobber":
+            clobber = True
+        elif a == "--print":
+            do_print = True
+        elif a == "--mark-up-in":
+            mark_up_in = True
+        elif a == "--test-map-pgs":
+            test_mode = "stats"
+        elif a == "--test-map-pgs-dump":
+            test_mode = "dump"
+        elif a == "--test-map-pgs-dump-all":
+            test_mode = "dump_all"
+        elif a == "--test-map-pg":
+            test_map_pg = next_arg(a)
+        elif a == "--pool":
+            test_pool = int(next_arg(a))
+        elif a == "--backend":
+            backend = next_arg(a)
+        elif a == "--upmap":
+            upmap_file = next_arg(a)
+        elif a == "--upmap-deviation":
+            upmap_deviation = int(next_arg(a))
+        elif a == "--upmap-max":
+            upmap_max = int(next_arg(a))
+        elif a == "--upmap-pool":
+            pending_pool_names.append(next_arg(a))
+        elif a == "--upmap-cleanup":
+            upmap_cleanup = True
+        elif a == "--export-crush":
+            export_crush = next_arg(a)
+        elif a == "--import-crush":
+            import_crush = next_arg(a)
+        elif mapfile is None and not a.startswith("-"):
+            mapfile = a
+        else:
+            print(f"unrecognized argument {a!r}", file=sys.stderr)
+            return 1
+        i += 1
+
+    if mapfile is None:
+        print("no mapfile given", file=sys.stderr)
+        return 1
+
+    if createsimple:
+        import os
+
+        if os.path.exists(mapfile) and not clobber:
+            print(
+                f"osdmaptool: {mapfile} exists, --clobber to overwrite",
+                file=sys.stderr,
+            )
+            return 1
+        m = build_simple(createsimple, pg_bits, pgp_bits)
+        save_osdmap(m, mapfile)
+        print(
+            f"osdmaptool: writing epoch {m.epoch} to {mapfile}",
+            file=sys.stderr,
+        )
+        return 0
+
+    m = load_osdmap(mapfile)
+    dirty = False
+
+    if import_crush:
+        m.crush = load_crush_text(import_crush)
+        dirty = True
+        print(
+            f"osdmaptool: imported crushmap from {import_crush}",
+            file=sys.stderr,
+        )
+    if mark_up_in:
+        for o in range(m.max_osd):
+            m.mark_up_in(o)
+        dirty = True
+    if export_crush:
+        save_crush_text(m.crush, export_crush)
+        print(
+            f"osdmaptool: exported crush map to {export_crush}",
+            file=sys.stderr,
+        )
+
+    for name in pending_pool_names:
+        found = [p for p, n in m.pool_name.items() if n == name]
+        if not found:
+            print(f"osdmaptool: pool {name!r} not found", file=sys.stderr)
+            return 1
+        upmap_pools.update(found)
+
+    if upmap_cleanup:
+        cancelled, remapped = m.clean_pg_upmaps()
+        for pg in cancelled:
+            print(f"ceph osd rm-pg-upmap-items {pg}")
+        for pg, items in remapped.items():
+            pairs = " ".join(f"{f} {t}" for f, t in items)
+            print(f"ceph osd pg-upmap-items {pg} {pairs}")
+        if cancelled or remapped:
+            dirty = True
+
+    if upmap_file:
+        from ceph_tpu.balancer import calc_pg_upmaps
+
+        lines = []
+        if upmap_file:
+            t0 = time.perf_counter()
+            res = calc_pg_upmaps(
+                m,
+                max_deviation=upmap_deviation,
+                max_iter=upmap_max,
+                only_pools=upmap_pools or None,
+                use_tpu=(backend == "jax"),
+            )
+            dt = time.perf_counter() - t0
+            print(f"Time elapsed {dt:g} secs", file=sys.stderr)
+            for pg in sorted(res.old_pg_upmap_items):
+                lines.append(f"ceph osd rm-pg-upmap-items {pg}")
+            for pg, items in sorted(res.new_pg_upmap_items.items()):
+                pairs = " ".join(f"{f} {t}" for f, t in items)
+                lines.append(f"ceph osd pg-upmap-items {pg} {pairs}")
+            print(f"upmap, max-count {upmap_max}, max deviation "
+                  f"{upmap_deviation}", file=sys.stderr)
+            if res.num_changed == 0:
+                print("Unable to find further optimization, or distribution"
+                      " is already perfect", file=sys.stderr)
+            with open(upmap_file, "w") as f:
+                f.write("\n".join(lines) + ("\n" if lines else ""))
+            dirty = True
+
+    if test_map_pg:
+        pg = PgId.parse(test_map_pg)
+        up, upp, acting, actp = m.pg_to_up_acting_osds(pg)
+        print(
+            f"parsed '{pg}' -> {pg}\n{pg} raw ({_vec(up)}, p{upp}) "
+            f"up ({_vec(up)}, p{upp}) acting ({_vec(acting)}, p{actp})"
+        )
+    if test_mode:
+        test_map_pgs(
+            m,
+            only_pool=test_pool,
+            dump=None if test_mode == "stats" else test_mode,
+            backend=backend,
+        )
+    if do_print:
+        import json
+
+        d = osdmap_to_dict(m)
+        d.pop("crush")
+        print(json.dumps(d, indent=1))
+
+    if dirty:
+        save_osdmap(m, mapfile)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

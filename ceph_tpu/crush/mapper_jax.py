@@ -39,7 +39,8 @@ from ceph_tpu.core.rjenkins import crush_hash32_2, crush_hash32_3, crush_hash32_
 from ceph_tpu.crush.soa import CrushArrays
 from ceph_tpu.crush.types import BucketAlg, ITEM_NONE, RuleOp
 
-S64_MIN = jnp.int64(-(2**63))
+S64_MIN = -(2**63)  # plain int: converted at trace time (keeps import
+                    # free of device ops so backend fallback can happen)
 
 # descent status codes
 _DESCENDING = 0

@@ -1,0 +1,225 @@
+"""CrushTester — the batched test driver behind `crushtool --test`.
+
+Semantics-compatible with the reference's tester (reference
+src/crush/CrushTester.cc:477-730): per rule × numrep × x, run the mapping,
+accumulate per-device utilization, result-size histogram, bad mappings, and
+optional RNG-simulated placement for comparison (random_placement,
+CrushTester.cc:260).  Output lines match the reference's formatting so cram
+transcripts stay comparable.
+
+The x-loop — the reference's single-threaded hot loop (1 `crush_do_rule`
+per PG) — runs here as ONE vmapped XLA call per (rule, numrep) through
+ceph_tpu.crush.mapper_jax (`backend="jax"`), or through the pure-Python
+host mapper for differential checks (`backend="ref"`).
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.core.rjenkins import crush_hash32_2
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.types import CrushMap, ITEM_NONE
+
+
+def _vec(out) -> str:
+    """C++ operator<< for vector<int>: [a,b,c]."""
+    return "[" + ",".join(str(int(o)) for o in out) + "]"
+
+
+@dataclass
+class TesterConfig:
+    min_x: int = 0
+    max_x: int = 1023
+    rule: int = -1  # -1 = all rules
+    min_rep: int = -1
+    max_rep: int = -1
+    num_rep: int = -1
+    pool_id: int = -1
+    weights: dict[int, int] = field(default_factory=dict)  # osd -> 16.16
+    backend: str = "jax"  # jax | ref
+    simulate: bool = False
+    show_mappings: bool = False
+    show_bad_mappings: bool = False
+    show_utilization: bool = False
+    show_utilization_all: bool = False
+    show_statistics: bool = False
+
+
+class CrushTester:
+    def __init__(self, m: CrushMap, cfg: TesterConfig, out=None):
+        self.m = m
+        self.cfg = cfg
+        self.out = out if out is not None else sys.stdout
+        self.weight = [0x10000] * m.max_devices
+        for osd, w in cfg.weights.items():
+            if 0 <= osd < m.max_devices:
+                self.weight[osd] = w
+
+    # -- mapping backends --------------------------------------------------
+    def _map_batch_jax(self, ruleno: int, xs: np.ndarray, nr: int):
+        from ceph_tpu.utils import ensure_jax_backend
+
+        ensure_jax_backend()
+        from ceph_tpu.crush.mapper_jax import compile_batched
+
+        fn = compile_batched(self.m_arrays(), ruleno, nr)
+        return np.asarray(fn(xs.astype(np.uint32),
+                             np.asarray(self.weight, np.uint32)))
+
+    _arrays_cache = None
+
+    def m_arrays(self):
+        if self._arrays_cache is None:
+            from ceph_tpu.crush.soa import build_arrays
+
+            self._arrays_cache = build_arrays(self.m)
+        return self._arrays_cache
+
+    def _map_one_ref(self, ruleno: int, x: int, nr: int) -> list[int]:
+        return mapper_ref.do_rule(self.m, ruleno, x, nr, self.weight)
+
+    def _random_placement(
+        self, rng: np.random.Generator, nr: int
+    ) -> list[int]:
+        """Weighted sample without replacement (reference
+        CrushTester.cc:260-292 random_placement)."""
+        w = np.asarray(self.weight, np.float64)
+        total = w.sum()
+        out: list[int] = []
+        if total <= 0:
+            return out
+        for _ in range(nr):
+            p = w / w.sum() if w.sum() > 0 else None
+            if p is None:
+                break
+            pick = int(rng.choice(len(w), p=p))
+            out.append(pick)
+            w = w.copy()
+            w[pick] = 0
+        return out
+
+    # -- the test loop -----------------------------------------------------
+    def test(self) -> int:
+        cfg, m = self.cfg, self.m
+        rules = (
+            [cfg.rule]
+            if cfg.rule >= 0
+            else [i for i, r in enumerate(m.rules) if r is not None]
+        )
+        w = self.out
+        rng = np.random.default_rng(0)
+        for r in rules:
+            rule = m.rules[r] if r < len(m.rules) else None
+            if rule is None:
+                print(f"rule {r} dne", file=w)
+                continue
+            rname = m.rule_names.get(r, f"rule{r}")
+            if cfg.num_rep >= 0:
+                minr = maxr = cfg.num_rep
+            elif cfg.min_rep >= 0 and cfg.max_rep >= 0:
+                minr, maxr = cfg.min_rep, cfg.max_rep
+            else:
+                minr, maxr = rule.min_size, rule.max_size
+            if cfg.show_statistics:
+                print(
+                    f"rule {r} ({rname}), x = {cfg.min_x}..{cfg.max_x}, "
+                    f"numrep = {minr}..{maxr}",
+                    file=w,
+                )
+            n_x = cfg.max_x - cfg.min_x + 1
+            for nr in range(minr, maxr + 1):
+                per = np.zeros(m.max_devices, np.int64)
+                sizes: dict[int, int] = {}
+                xs = np.arange(cfg.min_x, cfg.max_x + 1, dtype=np.int64)
+                if cfg.simulate:
+                    rows = [
+                        self._random_placement(rng, nr) for _ in range(n_x)
+                    ]
+                    prefix = "RNG"
+                elif cfg.backend == "ref":
+                    real = (
+                        xs
+                        if cfg.pool_id == -1
+                        else [
+                            int(crush_hash32_2(x, cfg.pool_id & 0xFFFFFFFF))
+                            for x in xs
+                        ]
+                    )
+                    rows = [
+                        self._map_one_ref(r, int(rx), nr) for rx in real
+                    ]
+                    prefix = "CRUSH"
+                else:
+                    real = (
+                        xs.astype(np.uint32)
+                        if cfg.pool_id == -1
+                        else np.asarray(
+                            crush_hash32_2(
+                                xs.astype(np.uint32),
+                                np.uint32(cfg.pool_id & 0xFFFFFFFF),
+                            )
+                        )
+                    )
+                    padded = self._map_batch_jax(r, real, nr)
+                    rows = [
+                        [o for o in row if o != ITEM_NONE]
+                        if rule.type == 1
+                        else list(row)
+                        for row in padded.tolist()
+                    ]
+                    prefix = "CRUSH"
+                for x, out_row in zip(xs, rows):
+                    if cfg.show_mappings:
+                        print(
+                            f"{prefix} rule {r} x {x} {_vec(out_row)}",
+                            file=w,
+                        )
+                    has_none = False
+                    realsize = 0
+                    for o in out_row:
+                        if o != ITEM_NONE:
+                            per[o] += 1
+                            realsize += 1
+                        else:
+                            has_none = True
+                    sizes[len(out_row)] = sizes.get(len(out_row), 0) + 1
+                    if cfg.show_bad_mappings and (
+                        len(out_row) != nr or has_none
+                    ):
+                        print(
+                            f"bad mapping rule {r} x {x} num_rep {nr} "
+                            f"result {_vec(out_row)}",
+                            file=w,
+                        )
+                total_w = sum(self.weight)
+                expected = (
+                    np.asarray(self.weight, np.float64)
+                    / max(total_w, 1)
+                    * n_x
+                    * nr
+                )
+                if cfg.show_utilization and not cfg.show_statistics:
+                    for i in range(m.max_devices):
+                        print(f"  device {i}:\t{per[i]}", file=w)
+                if cfg.show_statistics:
+                    for sz in sorted(sizes):
+                        print(
+                            f"rule {r} ({rname}) num_rep {nr} "
+                            f"result size == {sz}:\t{sizes[sz]}/{n_x}",
+                            file=w,
+                        )
+                    if cfg.show_utilization or cfg.show_utilization_all:
+                        for i in range(m.max_devices):
+                            if cfg.show_utilization_all or (
+                                expected[i] > 0 and per[i] > 0
+                            ):
+                                print(
+                                    f"  device {i}:\t\t stored : {per[i]}"
+                                    f"\t expected : {expected[i]:.0f}",
+                                    file=w,
+                                )
+        return 0

@@ -246,7 +246,9 @@ class CrushMap:
         # naming layers (CrushWrapper equivalents)
         self.type_names: dict[int, str] = {0: "osd"}
         self.item_names: dict[int, str] = {}
-        self.item_classes: dict[int, str] = {}
+        self.rule_names: dict[int, str] = {}
+        self.item_classes: dict[int, str] = {}  # device id -> class name
+        self.class_names: dict[int, str] = {}  # class id -> class name
         self.class_bucket: dict[int, dict[int, int]] = {}  # orig id -> class id -> shadow id
         self.choose_tries_histogram: list[int] | None = None
 
@@ -298,6 +300,124 @@ class CrushMap:
     def refresh_derived(self) -> None:
         for b in self.buckets.values():
             b.finalize_derived(self.tunables.straw_calc_version)
+
+    def parent_of(self, item: int) -> int | None:
+        for bid, b in self.buckets.items():
+            if item in b.items:
+                return bid
+        return None
+
+    def adjust_item_weight(self, item: int, weight: int) -> None:
+        """Set a device/bucket's weight and propagate the delta up every
+        ancestor chain (reference CrushWrapper::adjust_item_weight /
+        bucket_adjust_item_weight semantics)."""
+        shadows = {
+            sid for per in self.class_bucket.values() for sid in per.values()
+        }
+        for bid, b in self.buckets.items():
+            if bid in shadows:
+                continue
+            for j, it in enumerate(b.items):
+                if it == item:
+                    delta = weight - b.weights[j]
+                    b.weights[j] = weight
+                    # bubble the delta up to the roots
+                    cur = bid
+                    while True:
+                        parent = self.parent_of(cur)
+                        if parent is None or parent in shadows:
+                            break
+                        pb = self.buckets[parent]
+                        idx = pb.items.index(cur)
+                        pb.weights[idx] += delta
+                        cur = parent
+        self.refresh_derived()
+
+    # -- device classes ----------------------------------------------------
+    def class_id(self, name: str) -> int:
+        for cid, n in self.class_names.items():
+            if n == name:
+                return cid
+        cid = max(self.class_names.keys(), default=-1) + 1
+        self.class_names[cid] = name
+        return cid
+
+    def build_class_shadow_trees(
+        self, preferred: dict[int, dict[str, int]] | None = None
+    ) -> None:
+        """Build per-class shadow hierarchies — the semantics of the
+        reference's class-filtered trees (`device_class_clone`, reference
+        src/crush/CrushWrapper.cc:2693 / rebuild_roots_with_classes): for
+        every device class, clone each bucket keeping only that class's
+        devices, so `step take <root> class <c>` TAKEs the shadow root.
+        Shadow buckets are ordinary buckets here (the SoA kernel maps them
+        like any other); they are named "<orig>~<class>" and recorded in
+        class_bucket[orig][class_id].
+
+        `preferred` pins shadow ids: {orig_bucket_id: {class_name: id}} —
+        used by the text compiler to honor `id -N class <c>` declarations
+        so choose_args entries keyed by shadow bucket id stay attached to
+        the right bucket."""
+        # drop previous shadows
+        old = {
+            sid
+            for per in self.class_bucket.values()
+            for sid in per.values()
+        }
+        for sid in old:
+            self.buckets.pop(sid, None)
+            self.item_names.pop(sid, None)
+        self.class_bucket = {}
+        classes = sorted(set(self.item_classes.values()))
+        if not classes:
+            return
+        originals = sorted(self.buckets.keys(), reverse=True)  # -1, -2, ...
+
+        for cname in classes:
+            cid = self.class_id(cname)
+            shadow_of: dict[int, int] = {}
+
+            def clone(bid: int) -> int:
+                if bid in shadow_of:
+                    return shadow_of[bid]
+                b = self.buckets[bid]
+                items: list[int] = []
+                weights: list[int] = []
+                for it, w in zip(b.items, b.weights):
+                    if it >= 0:
+                        if self.item_classes.get(it) == cname:
+                            items.append(it)
+                            weights.append(w)
+                    else:
+                        sid = clone(it)
+                        items.append(sid)
+                        weights.append(self.buckets[sid].weight)
+                want_id = (preferred or {}).get(bid, {}).get(cname)
+                if want_id is not None and want_id in self.buckets:
+                    want_id = None
+                sid = self.add_bucket(
+                    b.alg, b.type, items, weights, hash=b.hash,
+                    id=want_id,
+                    name=(
+                        f"{self.item_names[bid]}~{cname}"
+                        if bid in self.item_names else None
+                    ),
+                )
+                shadow_of[bid] = sid
+                self.class_bucket.setdefault(bid, {})[cid] = sid
+                return sid
+
+            for bid in originals:
+                clone(bid)
+
+    def split_id_class(self, item: int) -> tuple[int, int]:
+        """shadow id -> (original id, class id); (item, -1) if not a
+        shadow (reference CrushWrapper::split_id_class)."""
+        for orig, per in self.class_bucket.items():
+            for cid, sid in per.items():
+                if sid == item:
+                    return orig, cid
+        return item, -1
 
     # -- convenience -------------------------------------------------------
     def make_replicated_rule(

@@ -1,0 +1,521 @@
+"""Clay (coupled-layer) MSR regenerating code.
+
+Re-implementation of the reference's clay plugin semantics (reference
+src/erasure-code/clay/ErasureCodeClay.{h,cc}): an (k, m, d) MSR code built
+by coupling q^t "layers" (planes) of an inner scalar MDS code, where
+q = d-k+1, t = ceil((k+m)/q), nu = q*t-(k+m) virtual shortened nodes, and
+every chunk splits into sub_chunk_no = q^t sub-chunks.  Single-chunk repair
+contacts d helpers and reads only a 1/q fraction of each — the
+minimum-bandwidth property (reference minimum_to_repair :325,
+get_repair_subchunks :360).
+
+Structure of this port (array-first, not buffer-slice-first):
+- chunks live as numpy arrays [sub_chunk_no, sc_size] per node id in the
+  padded q*t grid (external chunk i ↔ node i for data, i+nu for parity);
+- the pair-wise coupling (reference's "pft" jerasure k=2,m=2 code,
+  get_{coupled,uncoupled}_* :814-871) is a (2,2) RS code over the 4-tuple
+  [c_lo, c_hi, u_lo, u_hi]: any two symbols determine the rest;
+- the inner MDS across a plane (decode_uncoupled :742) is our RS(k+nu, m)
+  vandermonde code;
+- decode_layered (:647) walks planes in intersection-score order, exactly
+  the reference's schedule.
+
+The per-plane math vectorizes over the sub-chunk byte axis; every pair /
+MDS operation is a GF(2^8) matmul over [*, sc_size] arrays, so the whole
+decode runs as batched table ops (and rides the same engines as ec.rs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ec import matrices
+from ceph_tpu.ec.gf import gf_matvec_data
+from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
+
+
+def _pow_int(a: int, x: int) -> int:
+    return a**x
+
+
+class _PairTransform:
+    """(2,2) RS code over [c_lo, c_hi, u_lo, u_hi]; recovers any 2 missing
+    symbols from the other 2 (the reference's pft scalar code)."""
+
+    def __init__(self):
+        self.C = matrices.vandermonde_rs(2, 2)
+
+    def recover(
+        self, known: dict[int, np.ndarray], want: list[int]
+    ) -> list[np.ndarray]:
+        present = sorted(known)
+        R = matrices.recover_matrix(self.C, present, want)
+        stack = np.stack([known[i] for i in present[:2]])
+        out = gf_matvec_data(R, stack.reshape(2, -1))
+        shp = known[present[0]].shape
+        return [row.reshape(shp) for row in out]
+
+
+class ClayCode(ErasureCode):
+    """plugin=clay; profile: k, m, [d=k+m-1], [scalar_mds], [technique]."""
+
+    def __init__(self):
+        super().__init__()
+        self.d = 0
+        self.q = 0
+        self.t = 0
+        self.nu = 0
+        self.sub_chunk_no = 0
+
+    # -- profile -----------------------------------------------------------
+    def parse(self, profile: dict) -> None:
+        self.k, self.m = 4, 2  # reference DEFAULT_K/DEFAULT_M
+        super().parse(profile)
+        k, m = self.k, self.m
+        try:
+            self.d = int(profile.get("d", k + m - 1))
+        except (TypeError, ValueError):
+            raise ErasureCodeProfileError("d must be an integer")
+        if not (k <= self.d <= k + m - 1):
+            raise ErasureCodeProfileError(
+                f"value of d {self.d} must be within [{k},{k + m - 1}]"
+            )
+        self.q = self.d - k + 1
+        self.nu = (self.q - (k + m) % self.q) % self.q
+        if k + m + self.nu > 254:
+            raise ErasureCodeProfileError("k+m+nu must be <= 254")
+        self.t = (k + m + self.nu) // self.q
+        self.sub_chunk_no = _pow_int(self.q, self.t)
+        # inner MDS across each plane: (k+nu) data + m parity
+        technique = profile.get("technique", "reed_sol_van")
+        maker = {
+            "reed_sol_van": matrices.vandermonde_rs,
+            "cauchy_orig": matrices.cauchy_orig,
+            "cauchy_good": matrices.cauchy_good,
+            "cauchy": matrices.isa_cauchy,
+        }.get(technique)
+        if maker is None:
+            raise ErasureCodeProfileError(
+                f"clay: unsupported technique {technique!r}"
+            )
+        self.mds_C = maker(k + self.nu, m)
+        self.pft = _PairTransform()
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_alignment(self) -> int:
+        # sub_chunk_no * k * inner alignment (reference get_chunk_size)
+        return self.sub_chunk_no * self.k * self.w * 4
+
+    # -- plane geometry ----------------------------------------------------
+    def _z_vec(self, z: int) -> list[int]:
+        """base-q digits of z, most-significant first (reference
+        get_plane_vector :888)."""
+        v = [0] * self.t
+        for i in range(self.t):
+            v[self.t - 1 - i] = z % self.q
+            z //= self.q
+        return v
+
+    def _z_sw(self, z: int, x: int, y: int, z_vec: list[int]) -> int:
+        return z + (x - z_vec[y]) * _pow_int(self.q, self.t - 1 - y)
+
+    # -- pairwise coupling helpers ----------------------------------------
+    # canonical 4-tuple: positions 0/2 = coupled/uncoupled of the pair
+    # node with LARGER x, 1/3 = the smaller-x node (the reference's
+    # i0..i3 swap when z_vec[y] > x)
+    def _pair_indices(self, x: int, zy: int) -> tuple[int, int, int, int]:
+        """returns positions (c_xy, c_sw, u_xy, u_sw) in the 4-tuple."""
+        if zy > x:
+            return 1, 0, 3, 2
+        return 0, 1, 2, 3
+
+    # -- inner MDS over a plane -------------------------------------------
+    def _mds_recover(
+        self,
+        U: dict[int, np.ndarray],
+        z: int,
+        erased: set[int],
+    ) -> None:
+        """decode_uncoupled (reference :742): recover U[erased][z] from the
+        other nodes' U[z]."""
+        n = self.q * self.t
+        present = sorted(set(range(n)) - erased)[: self.k + self.nu]
+        missing = sorted(erased)
+        R = matrices.recover_matrix(self.mds_C, present, missing)
+        stack = np.stack([U[i][z] for i in present])
+        out = gf_matvec_data(R, stack)
+        for row, i in zip(out, missing):
+            U[i][z] = row
+
+    # -- layered decode (reference decode_layered :647) --------------------
+    def _decode_layered(
+        self, erased: set[int], chunks: dict[int, np.ndarray]
+    ) -> None:
+        q, t, m = self.q, self.t, self.m
+        n = q * t
+        erased = set(erased)
+        for i in range(self.k + self.nu, n):
+            if len(erased) >= m:
+                break
+            erased.add(i)
+        assert len(erased) == m
+
+        sc_shape = chunks[0].shape[1:]
+        U = {
+            i: np.zeros((self.sub_chunk_no,) + sc_shape, np.uint8)
+            for i in range(n)
+        }
+
+        order = [0] * self.sub_chunk_no
+        for z in range(self.sub_chunk_no):
+            zv = self._z_vec(z)
+            order[z] = sum(1 for i in erased if i % q == zv[i // q])
+        max_score = max(order, default=0)
+
+        for score in range(max_score + 1):
+            planes = [z for z in range(self.sub_chunk_no) if order[z] == score]
+            for z in planes:
+                self._decode_erasures(erased, z, chunks, U)
+            for z in planes:
+                zv = self._z_vec(z)
+                for node_xy in sorted(erased):
+                    x, y = node_xy % q, node_xy // q
+                    node_sw = y * q + zv[y]
+                    if zv[y] != x:
+                        if node_sw not in erased:
+                            self._recover_type1(chunks, U, x, y, z, zv)
+                        elif zv[y] < x:
+                            self._coupled_from_uncoupled(
+                                chunks, U, x, y, z, zv
+                            )
+                    else:
+                        chunks[node_xy][z] = U[node_xy][z]
+
+    def _decode_erasures(
+        self,
+        erased: set[int],
+        z: int,
+        chunks: dict[int, np.ndarray],
+        U: dict[int, np.ndarray],
+    ) -> None:
+        """reference decode_erasures :714: fill U for live nodes, then MDS."""
+        q, t = self.q, self.t
+        zv = self._z_vec(z)
+        for x in range(q):
+            for y in range(t):
+                node_xy = q * y + x
+                node_sw = q * y + zv[y]
+                if node_xy in erased:
+                    continue
+                if zv[y] < x:
+                    self._uncoupled_from_coupled(chunks, U, x, y, z, zv)
+                elif zv[y] == x:
+                    U[node_xy][z] = chunks[node_xy][z]
+                else:
+                    if node_sw in erased:
+                        self._uncoupled_from_coupled(chunks, U, x, y, z, zv)
+        self._mds_recover(U, z, erased)
+
+    # the three pair operations (reference :775-871)
+    def _recover_type1(self, chunks, U, x, y, z, zv):
+        """erased coupled symbol from live partner + own uncoupled."""
+        q = self.q
+        node_xy, node_sw = y * q + x, y * q + zv[y]
+        z_sw = self._z_sw(z, x, y, zv)
+        c_xy, c_sw, u_xy, u_sw = self._pair_indices(x, zv[y])
+        known = {
+            c_sw: chunks[node_sw][z_sw],
+            u_xy: U[node_xy][z],
+        }
+        (rec,) = self.pft.recover(known, [c_xy])
+        chunks[node_xy][z] = rec
+
+    def _coupled_from_uncoupled(self, chunks, U, x, y, z, zv):
+        """both coupled symbols of the pair from both uncoupled."""
+        q = self.q
+        node_xy, node_sw = y * q + x, y * q + zv[y]
+        z_sw = self._z_sw(z, x, y, zv)
+        # no index swap here (reference get_coupled_from_uncoupled asserts
+        # zv[y] < x): position 0 ↔ node_xy, 1 ↔ node_sw
+        known = {2: U[node_xy][z], 3: U[node_sw][z_sw]}
+        rec0, rec1 = self.pft.recover(known, [0, 1])
+        chunks[node_xy][z] = rec0
+        chunks[node_sw][z_sw] = rec1
+
+    def _uncoupled_from_coupled(self, chunks, U, x, y, z, zv):
+        """both uncoupled symbols of the pair from both coupled."""
+        q = self.q
+        node_xy, node_sw = y * q + x, y * q + zv[y]
+        z_sw = self._z_sw(z, x, y, zv)
+        c_xy, c_sw, u_xy, u_sw = self._pair_indices(x, zv[y])
+        known = {c_xy: chunks[node_xy][z], c_sw: chunks[node_sw][z_sw]}
+        rec_lo, rec_hi = self.pft.recover(known, [2, 3])
+        rec = {2: rec_lo, 3: rec_hi}
+        U[node_xy][z] = rec[u_xy]
+        U[node_sw][z_sw] = rec[u_sw]
+
+    # -- node/chunk plumbing ----------------------------------------------
+    def _to_nodes(
+        self, ext: dict[int, np.ndarray], sc_size: int
+    ) -> dict[int, np.ndarray]:
+        """external chunk id -> padded node grid ([sub_chunk_no, sc])."""
+        n = self.q * self.t
+        nodes: dict[int, np.ndarray] = {}
+        for i in range(self.k + self.m):
+            nid = i if i < self.k else i + self.nu
+            if i in ext:
+                nodes[nid] = (
+                    np.asarray(ext[i], np.uint8)
+                    .reshape(self.sub_chunk_no, sc_size)
+                    .copy()
+                )
+            else:
+                nodes[nid] = np.zeros(
+                    (self.sub_chunk_no, sc_size), np.uint8
+                )
+        for i in range(self.k, self.k + self.nu):
+            nodes[i] = np.zeros((self.sub_chunk_no, sc_size), np.uint8)
+        return nodes
+
+    # -- public API --------------------------------------------------------
+    def encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        k, m = self.k, self.m
+        cs = data.shape[1]
+        assert cs % self.sub_chunk_no == 0, (
+            f"chunk size {cs} not a multiple of sub_chunk_no "
+            f"{self.sub_chunk_no}"
+        )
+        sc = cs // self.sub_chunk_no
+        ext = {i: data[i] for i in range(k)}
+        nodes = self._to_nodes(ext, sc)
+        parity_nodes = {
+            i + self.nu for i in range(k, k + m)
+        }
+        self._decode_layered(parity_nodes, nodes)
+        out = np.zeros((k + m, cs), np.uint8)
+        for i in range(k + m):
+            nid = i if i < k else i + self.nu
+            out[i] = nodes[nid].reshape(-1)
+        return out
+
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks: dict[int, np.ndarray],
+        chunk_size: int,
+    ) -> dict[int, np.ndarray]:
+        k, m = self.k, self.m
+        if len(chunks) < k:
+            raise ValueError(f"cannot decode: {len(chunks)} < k={k}")
+        sc = chunk_size // self.sub_chunk_no
+        erased = {
+            (i if i < k else i + self.nu)
+            for i in range(k + m)
+            if i not in chunks
+        }
+        nodes = self._to_nodes(
+            {i: np.asarray(c, np.uint8) for i, c in chunks.items()}, sc
+        )
+        self._decode_layered(erased, nodes)
+        out = dict(chunks)
+        for i in range(k + m):
+            nid = i if i < k else i + self.nu
+            if i not in out:
+                out[i] = nodes[nid].reshape(-1)
+        return out
+
+    # -- repair (minimum-bandwidth single-node recovery) -------------------
+    def is_repair(
+        self, want_to_read: set[int], available: set[int]
+    ) -> bool:
+        """reference is_repair :305."""
+        if want_to_read <= available:
+            return False
+        if len(want_to_read) > 1:
+            return False
+        i = next(iter(want_to_read))
+        lost = i if i < self.k else i + self.nu
+        for x in range(self.q):
+            node = (lost // self.q) * self.q + x
+            node = node if node < self.k else node - self.nu
+            if node != i and node not in available:
+                return False
+        return len(available) >= self.d
+
+    def get_repair_subchunks(self, lost_node: int) -> list[tuple[int, int]]:
+        """(index, count) runs of the 1/q sub-chunks helpers must send
+        (reference get_repair_subchunks :360)."""
+        q, t = self.q, self.t
+        y_lost, x_lost = lost_node // q, lost_node % q
+        seq = _pow_int(q, t - 1 - y_lost)
+        num_seq = _pow_int(q, y_lost)
+        out = []
+        index = x_lost * seq
+        for _ in range(num_seq):
+            out.append((index, seq))
+            index += q * seq
+        return out
+
+    def minimum_to_repair(
+        self, want_to_read: set[int], available: set[int]
+    ) -> dict[int, list[tuple[int, int]]]:
+        """reference minimum_to_repair :325: d helpers + their sub-chunk
+        ranges, preferring the lost node's q-column."""
+        i = next(iter(want_to_read))
+        lost = i if i < self.k else i + self.nu
+        sub_ind = self.get_repair_subchunks(lost)
+        minimum: dict[int, list[tuple[int, int]]] = {}
+        for j in range(self.q):
+            if j != lost % self.q:
+                rep = (lost // self.q) * self.q + j
+                if rep < self.k:
+                    minimum[rep] = sub_ind
+                elif rep >= self.k + self.nu:
+                    minimum[rep - self.nu] = sub_ind
+        for c in sorted(available):
+            if len(minimum) >= self.d:
+                break
+            minimum.setdefault(c, sub_ind)
+        assert len(minimum) == self.d
+        return minimum
+
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        if self.is_repair(want_to_read, available):
+            return set(self.minimum_to_repair(want_to_read, available))
+        return super().minimum_to_decode(want_to_read, available)
+
+    def repair(
+        self,
+        want_to_read: set[int],
+        helper_chunks: dict[int, np.ndarray],
+        chunk_size: int,
+    ) -> dict[int, np.ndarray]:
+        """Rebuild one chunk from d helpers' repair sub-chunks.  Helper
+        arrays may be full chunks or just the repair sub-chunk runs
+        (repair_blocksize = chunk_size/q).  reference repair :390 +
+        repair_one_lost_chunk :462."""
+        assert len(want_to_read) == 1
+        assert len(helper_chunks) == self.d
+        q, t = self.q, self.t
+        i = next(iter(want_to_read))
+        lost = i if i < self.k else i + self.nu
+        sub_ind = self.get_repair_subchunks(lost)
+        repair_sub_count = sum(c for _, c in sub_ind)
+        sc = chunk_size // self.sub_chunk_no
+        repair_planes = [
+            z for ind, cnt in sub_ind for z in range(ind, ind + cnt)
+        ]
+        plane_pos = {z: j for j, z in enumerate(repair_planes)}
+
+        # node-indexed helper data [repair_sub_count, sc]
+        helpers: dict[int, np.ndarray] = {}
+        for ext_i, buf in helper_chunks.items():
+            nid = ext_i if ext_i < self.k else ext_i + self.nu
+            arr = np.asarray(buf, np.uint8).reshape(-1, sc)
+            if arr.shape[0] == self.sub_chunk_no:
+                arr = arr[repair_planes]
+            assert arr.shape[0] == repair_sub_count
+            helpers[nid] = arr
+        for j in range(self.k, self.k + self.nu):
+            helpers[j] = np.zeros((repair_sub_count, sc), np.uint8)
+
+        aloof = {
+            (j if j < self.k else j + self.nu)
+            for j in range(self.k + self.m)
+            if j != i and j not in helper_chunks
+        }
+
+        recovered = np.zeros((self.sub_chunk_no, sc), np.uint8)
+        U = {
+            n: np.zeros((self.sub_chunk_no, sc), np.uint8)
+            for n in range(q * t)
+        }
+        erasures = {lost - lost % q + x for x in range(q)} | aloof
+
+        # order planes by intersection score over erasures+aloof
+        ordered: dict[int, list[int]] = {}
+        for z in repair_planes:
+            zv = self._z_vec(z)
+            score = sum(
+                1 for nd in ({lost} | aloof) if nd % q == zv[nd // q]
+            )
+            assert score > 0
+            ordered.setdefault(score, []).append(z)
+
+        for score in sorted(ordered):
+            for z in ordered[score]:
+                zv = self._z_vec(z)
+                # phase 1: fill U for live nodes
+                for y in range(t):
+                    for x in range(q):
+                        node_xy = y * q + x
+                        if node_xy in erasures:
+                            continue
+                        z_sw = self._z_sw(z, x, y, zv)
+                        node_sw = y * q + zv[y]
+                        c_xy, c_sw, u_xy, u_sw = self._pair_indices(
+                            x, zv[y]
+                        )
+                        if node_sw in aloof:
+                            # partner coupled unknown; use partner's U
+                            known = {
+                                c_xy: helpers[node_xy][plane_pos[z]],
+                                u_sw: U[node_sw][z_sw],
+                            }
+                            (rec,) = self.pft.recover(known, [u_xy])
+                            U[node_xy][z] = rec
+                        elif zv[y] != x:
+                            known = {
+                                c_xy: helpers[node_xy][plane_pos[z]],
+                                c_sw: helpers[node_sw][plane_pos[z_sw]],
+                            }
+                            rec_lo, rec_hi = self.pft.recover(
+                                known, [2, 3]
+                            )
+                            rec = {2: rec_lo, 3: rec_hi}
+                            U[node_xy][z] = rec[u_xy]
+                        else:
+                            U[node_xy][z] = helpers[node_xy][plane_pos[z]]
+                # phase 2: MDS across the plane
+                assert len(erasures) <= self.m
+                self._mds_recover(U, z, erasures)
+                # phase 3: recover coupled symbols of erased nodes
+                for nd in sorted(erasures):
+                    if nd in aloof:
+                        continue
+                    x, y = nd % q, nd // q
+                    node_sw = y * q + zv[y]
+                    z_sw = self._z_sw(z, x, y, zv)
+                    c_xy, c_sw, u_xy, u_sw = self._pair_indices(x, zv[y])
+                    if x == zv[y]:  # hole-dot pair
+                        recovered[z] = U[nd][z]
+                    else:
+                        assert node_sw == lost
+                        known = {
+                            c_xy: helpers[nd][plane_pos[z]],
+                            u_xy: U[nd][z],
+                        }
+                        (rec,) = self.pft.recover(known, [c_sw])
+                        recovered[z_sw] = rec
+        return {i: recovered.reshape(-1)}
+
+    def decode(
+        self,
+        want_to_read: set[int],
+        chunks: dict[int, np.ndarray],
+        chunk_size: int | None = None,
+    ) -> dict[int, np.ndarray]:
+        avail = set(chunks)
+        if want_to_read <= avail:
+            return {
+                i: np.asarray(chunks[i], np.uint8) for i in want_to_read
+            }
+        if chunk_size is not None and self.is_repair(want_to_read, avail):
+            first = next(iter(chunks.values()))
+            if chunk_size > len(np.asarray(first).reshape(-1)):
+                return self.repair(want_to_read, chunks, chunk_size)
+        return super().decode(want_to_read, chunks, chunk_size)

@@ -71,6 +71,9 @@ class JaxEngine:
     """Device GF matmul engine: M u8[R,S] × data u8[S,L] -> u8[R,L]."""
 
     def __init__(self, strategy: str | None = None, tile: int = _BIT_TILE):
+        from ceph_tpu.utils import ensure_jax_backend
+
+        ensure_jax_backend()
         if strategy is None:
             strategy = (
                 "bitplane"

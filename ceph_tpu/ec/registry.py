@@ -76,12 +76,19 @@ class XorExample(ErasureCode):
         return out
 
 
+def _make_clay(profile: dict) -> ErasureCode:
+    from ceph_tpu.ec.clay import ClayCode
+
+    return ClayCode()
+
+
 _PLUGINS = {
     "jerasure": _make_jerasure,
     "isa": _make_isa,
     "jax": _make_jax,
     "example": lambda p: XorExample(),
-    # clay / shec / lrc register themselves once implemented
+    "clay": _make_clay,
+    # shec / lrc register here once implemented
 }
 
 

@@ -361,6 +361,9 @@ class PoolMapper:
     """
 
     def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True):
+        from ceph_tpu.utils import ensure_jax_backend
+
+        ensure_jax_backend()
         self.m = m
         self.pool_id = pool_id
         ca = m.crush.choose_args.get(pool_id, m.crush.choose_args.get(-1))

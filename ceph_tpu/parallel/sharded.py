@@ -34,6 +34,9 @@ def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
     parallelism inventory; there is no tensor/pipeline dimension to shard,
     so the mesh is 1-D by design.)
     """
+    from ceph_tpu.utils import ensure_jax_backend
+
+    ensure_jax_backend()
     devs = jax.devices()
     if n_devices is None:
         n_devices = len(devs)

@@ -1,0 +1,3 @@
+from ceph_tpu.utils.platform import ensure_jax_backend
+
+__all__ = ["ensure_jax_backend"]

@@ -1,0 +1,39 @@
+"""Runtime platform guard.
+
+The session's JAX may be pinned (via env) to an accelerator plugin whose
+transport is unavailable (e.g. the TPU tunnel is down).  Library code and
+CLIs call `ensure_jax_backend()` before the first device op: if the
+configured platform fails to initialize, fall back to CPU with a warning
+instead of crashing — every kernel here runs correctly (just slower) on the
+host backend.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+_checked: str | None = None
+
+
+def ensure_jax_backend() -> str:
+    """Return the usable jax backend name, falling back to CPU if the
+    configured platform cannot initialize."""
+    global _checked
+    if _checked is not None:
+        return _checked
+    import jax
+
+    try:
+        jax.devices()
+        _checked = jax.default_backend()
+    except RuntimeError as e:
+        warnings.warn(
+            f"configured jax platform unavailable ({e}); "
+            "falling back to CPU",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+        _checked = "cpu"
+    return _checked

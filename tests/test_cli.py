@@ -1,0 +1,312 @@
+"""CLI tests — the cram-transcript pattern of the reference
+(reference src/test/cli/crushtool/*.t, src/test/cli/osdmaptool/*.t):
+run the tools in-process, assert on their output."""
+
+import io
+import json
+import re
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cli import crushtool, ec_benchmark, osdmaptool, psim
+from ceph_tpu.osd.io import load_osdmap
+
+
+def run_cli(mod, argv, capsys):
+    rc = mod.main(argv)
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+@pytest.fixture
+def crush_file(tmp_path):
+    from ceph_tpu.cli.crushtool import build_map
+    from ceph_tpu.crush.compiler import decompile
+
+    m = build_map(
+        16, [("host", "straw2", 4), ("root", "straw2", 0)]
+    )
+    m.make_replicated_rule(
+        min(m.buckets.keys(), key=lambda b: -b if False else b), 1
+    )
+    # root bucket is the last one created (holds the hosts)
+    p = tmp_path / "map.txt"
+    p.write_text(decompile(m))
+    return str(p)
+
+
+class TestCrushtool:
+    def test_build_and_tree(self, tmp_path, capsys):
+        out_f = str(tmp_path / "built.txt")
+        rc, out, err = run_cli(
+            crushtool,
+            ["--build", "--num_osds", "8",
+             "host", "straw2", "2", "root", "straw2", "0",
+             "-o", out_f],
+            capsys,
+        )
+        assert rc == 0
+        text = open(out_f).read()
+        assert "host host0" in text and "root root" in text
+        assert text.count("device ") == 8
+
+    def test_compile_decompile_roundtrip(self, tmp_path, capsys):
+        out_f = str(tmp_path / "built.txt")
+        run_cli(
+            crushtool,
+            ["--build", "--num_osds", "4", "host", "straw2", "2",
+             "root", "straw2", "0", "-o", out_f],
+            capsys,
+        )
+        rc, out, err = run_cli(crushtool, ["-d", out_f], capsys)
+        assert rc == 0
+        assert "# begin crush map" in out
+
+    def test_test_statistics(self, tmp_path, capsys):
+        out_f = str(tmp_path / "m.txt")
+        run_cli(
+            crushtool,
+            ["--build", "--num_osds", "8", "host", "straw2", "2",
+             "root", "straw2", "0", "-o", out_f],
+            capsys,
+        )
+        rc, out, err = run_cli(
+            crushtool,
+            ["-i", out_f, "--test", "--num-rep", "3",
+             "--min-x", "0", "--max-x", "255",
+             "--show-statistics", "--backend", "jax"],
+            capsys,
+        )
+        assert rc == 0
+        assert re.search(
+            r"rule 0 \(\w+\) num_rep 3 result size == 3:\t256/256", out
+        )
+
+    def test_bad_mappings_shown_when_exhausted(self, tmp_path, capsys):
+        # 2 hosts but ask for 3 distinct hosts -> bad mappings
+        out_f = str(tmp_path / "m.txt")
+        run_cli(
+            crushtool,
+            ["--build", "--num_osds", "4", "host", "straw2", "2",
+             "root", "straw2", "0", "-o", out_f],
+            capsys,
+        )
+        rc, out, err = run_cli(
+            crushtool,
+            ["-i", out_f, "--test", "--num-rep", "3",
+             "--min-x", "0", "--max-x", "63", "--show-bad-mappings",
+             "--backend", "jax"],
+            capsys,
+        )
+        assert rc == 0
+        assert "bad mapping rule 0" in out
+
+    def test_simulate(self, tmp_path, capsys):
+        out_f = str(tmp_path / "m.txt")
+        run_cli(
+            crushtool,
+            ["--build", "--num_osds", "4", "root", "straw2", "0",
+             "-o", out_f],
+            capsys,
+        )
+        rc, out, err = run_cli(
+            crushtool,
+            ["-i", out_f, "--test", "--num-rep", "2", "--max-x", "31",
+             "--simulate", "--show-mappings"],
+            capsys,
+        )
+        assert rc == 0
+        assert "RNG rule 0" in out
+
+
+class TestOsdmaptool:
+    def test_createsimple_and_stats(self, tmp_path, capsys):
+        mf = str(tmp_path / "om.json")
+        rc, out, err = run_cli(
+            osdmaptool, [mf, "--createsimple", "16", "--pg-bits", "4"],
+            capsys,
+        )
+        assert rc == 0 and "writing epoch" in err
+        # bare simple map: all OSDs on one "localhost" host, so the
+        # chooseleaf-host rule yields size-1 mappings (reference semantics)
+        rc, out, err = run_cli(
+            osdmaptool, [mf, "--test-map-pgs", "--backend", "jax"], capsys
+        )
+        assert rc == 0
+        assert "pool 0 pg_num 256" in out
+        assert "#osd\tcount\tfirst\tprimary\tc wt\twt" in out
+        assert " in 16" in out
+        assert re.search(r"size 1\t256", out)
+
+    def test_cram_flow_import_built_crush(self, tmp_path, capsys):
+        """The reference cram recipe (src/test/cli/osdmaptool/
+        test-map-pgs.t): createsimple + import a crushtool --build map,
+        then size==pool-size for every PG."""
+        mf = str(tmp_path / "om.json")
+        run_cli(osdmaptool, [mf, "--createsimple", "16", "--pg-bits", "4"],
+                capsys)
+        cf = str(tmp_path / "crush.txt")
+        run_cli(
+            crushtool,
+            ["--build", "--num_osds", "16", "node", "straw2", "4",
+             "root", "straw2", "0", "-o", cf],
+            capsys,
+        )
+        run_cli(osdmaptool, [mf, "--import-crush", cf], capsys)
+        rc, out, _ = run_cli(
+            osdmaptool,
+            [mf, "--mark-up-in", "--test-map-pgs", "--backend", "jax"],
+            capsys,
+        )
+        assert rc == 0
+        assert re.search(r"size 3\t256", out)
+
+    def test_backends_agree(self, tmp_path, capsys):
+        mf = str(tmp_path / "om.json")
+        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "4"],
+                capsys)
+        _, out_jax, _ = run_cli(
+            osdmaptool, [mf, "--test-map-pgs", "--backend", "jax"], capsys
+        )
+        _, out_ref, _ = run_cli(
+            osdmaptool, [mf, "--test-map-pgs", "--backend", "ref"], capsys
+        )
+        assert out_jax == out_ref
+
+    def test_dump_and_test_map_pg(self, tmp_path, capsys):
+        mf = str(tmp_path / "om.json")
+        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "3"],
+                capsys)
+        rc, out, _ = run_cli(
+            osdmaptool, [mf, "--test-map-pgs-dump", "--backend", "ref"],
+            capsys,
+        )
+        assert rc == 0
+        assert re.search(r"0\.0\t\[\d+(,\d+)*\]\t\d+", out)
+        rc, out, _ = run_cli(osdmaptool, [mf, "--test-map-pg", "0.5"],
+                             capsys)
+        assert "parsed '0.5'" in out
+
+    def test_upmap_writes_commands(self, tmp_path, capsys):
+        mf = str(tmp_path / "om.json")
+        run_cli(osdmaptool, [mf, "--createsimple", "12", "--pg-bits", "5"],
+                capsys)
+        uf = str(tmp_path / "upmaps.txt")
+        rc, out, err = run_cli(
+            osdmaptool,
+            [mf, "--upmap", uf, "--upmap-deviation", "1",
+             "--upmap-max", "20", "--backend", "ref"],
+            capsys,
+        )
+        assert rc == 0
+        assert "Time elapsed" in err
+        body = open(uf).read()
+        # createsimple is flat (single host) => chooseleaf osd remaps exist
+        for line in body.strip().splitlines():
+            assert line.startswith(
+                ("ceph osd pg-upmap-items", "ceph osd rm-pg-upmap-items")
+            )
+        # the upmaps persisted into the map file
+        m = load_osdmap(mf)
+        assert len(m.pg_upmap_items) == len(
+            [l for l in body.splitlines() if "pg-upmap-items" in l
+             and not l.startswith("ceph osd rm")]
+        )
+
+    def test_export_import_crush(self, tmp_path, capsys):
+        mf = str(tmp_path / "om.json")
+        run_cli(osdmaptool, [mf, "--createsimple", "4"], capsys)
+        cf = str(tmp_path / "cm.txt")
+        rc, _, err = run_cli(osdmaptool, [mf, "--export-crush", cf], capsys)
+        assert rc == 0 and "exported crush map" in err
+        rc, _, err = run_cli(osdmaptool, [mf, "--import-crush", cf], capsys)
+        assert rc == 0 and "imported crushmap" in err
+
+
+class TestEcBenchmark:
+    @pytest.mark.parametrize("workload", ["encode", "decode"])
+    def test_runs_and_prints(self, workload, capsys):
+        rc, out, _ = run_cli(
+            ec_benchmark,
+            ["--plugin", "jerasure", "--workload", workload,
+             "--size", "65536", "--iterations", "2",
+             "--parameter", "k=4", "--parameter", "m=2",
+             "--erasures", "2"],
+            capsys,
+        )
+        assert rc == 0
+        secs, kib = out.strip().split("\t")
+        assert float(secs) > 0
+        assert float(kib) == 128.0
+
+    def test_exhaustive_erasures(self, capsys):
+        rc, out, _ = run_cli(
+            ec_benchmark,
+            ["--plugin", "jerasure", "--workload", "decode",
+             "--size", "4096", "--iterations", "15",
+             "--parameter", "k=4", "--parameter", "m=2",
+             "--erasures", "2", "--erasures-generation", "exhaustive"],
+            capsys,
+        )
+        assert rc == 0
+
+
+class TestPsim:
+    def test_runs(self, capsys):
+        rc = psim.main(["8"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "osd.0" in out and "avg" in out
+
+
+class TestUpmapCleanup:
+    def test_cleanup_removes_stale_entries(self, tmp_path, capsys):
+        from ceph_tpu.osd.io import save_osdmap
+        from ceph_tpu.osd.types import PgId
+
+        mf = str(tmp_path / "om.json")
+        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "3"],
+                capsys)
+        m = load_osdmap(mf)
+        # a no-op item (frm not in raw for sure: use an id not in mapping)
+        m.pg_upmap_items[PgId(0, 0)] = [(7, 6)]
+        raw, _ = m.pg_to_raw_osds(PgId(0, 0))
+        m.pg_upmap_items[PgId(0, 0)] = [(99, 5)]  # frm never in raw
+        m.pg_upmap[PgId(0, 1)] = list(raw)  # redundant for a different pg?
+        save_osdmap(m, mf)
+        rc, out, err = run_cli(osdmaptool, [mf, "--upmap-cleanup"], capsys)
+        assert rc == 0
+        assert "rm-pg-upmap-items" in out
+        m2 = load_osdmap(mf)
+        assert PgId(0, 0) not in m2.pg_upmap_items
+
+
+class TestReweight:
+    def test_reweight_propagates_to_ancestors(self, tmp_path, capsys):
+        out_f = str(tmp_path / "m.txt")
+        run_cli(
+            crushtool,
+            ["--build", "--num_osds", "4", "host", "straw2", "2",
+             "root", "straw2", "0", "-o", out_f],
+            capsys,
+        )
+        new_f = str(tmp_path / "m2.txt")
+        rc, _, _ = run_cli(
+            crushtool,
+            ["-i", out_f, "--reweight-item", "osd.0", "3.0",
+             "-o", new_f],
+            capsys,
+        )
+        assert rc == 0
+        from ceph_tpu.crush.compiler import compile_text
+
+        m = compile_text(open(new_f).read())
+        by_name = {v: k for k, v in m.item_names.items()}
+        h0, root = by_name["host0"], by_name["root"]
+        # host0 itself: osd.0 now 3.0
+        assert m.buckets[h0].weights[0] == 3 * 0x10000
+        # root's entry for host0 reflects the propagated delta (2->4)
+        idx = m.buckets[root].items.index(h0)
+        assert m.buckets[root].weights[idx] == 4 * 0x10000

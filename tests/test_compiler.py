@@ -1,0 +1,199 @@
+"""Text compiler tests: compile/decompile roundtrips (the contract pinned by
+the reference's cram transcripts, reference src/test/cli/crushtool/*.t) and
+device-class shadow-tree mapping."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.compiler import CompileError, compile_text, decompile
+from ceph_tpu.crush.types import BucketAlg, RuleOp
+
+SAMPLE = """
+# begin crush map
+tunable choose_local_tries 0
+tunable choose_local_fallback_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+tunable straw_calc_version 1
+tunable allowed_bucket_algs 54
+
+# devices
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+
+# types
+type 0 osd
+type 1 host
+type 11 root
+
+# buckets
+host host0 {
+\tid -1
+\talg straw2
+\thash 0
+\titem osd.0 weight 1.00000
+\titem osd.1 weight 2.00000
+}
+host host1 {
+\tid -2
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.00000
+\titem osd.3 weight 1.00000
+}
+root default {
+\tid -3
+\talg straw2
+\thash 0
+\titem host0 weight 3.00000
+\titem host1 weight 2.00000
+}
+
+# rules
+rule replicated_rule {
+\tid 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+
+# end crush map
+"""
+
+CLASSED = """
+device 0 osd.0 class hdd
+device 1 osd.1 class ssd
+device 2 osd.2 class hdd
+device 3 osd.3 class ssd
+
+type 0 osd
+type 1 host
+type 11 root
+
+host host0 {
+\tid -1
+\talg straw2
+\thash 0
+\titem osd.0 weight 1.00000
+\titem osd.1 weight 1.00000
+}
+host host1 {
+\tid -2
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.00000
+\titem osd.3 weight 1.00000
+}
+root default {
+\tid -3
+\talg straw2
+\thash 0
+\titem host0 weight 2.00000
+\titem host1 weight 2.00000
+}
+
+rule ssd_rule {
+\tid 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default class ssd
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+"""
+
+
+class TestCompile:
+    def test_parses_sample(self):
+        m = compile_text(SAMPLE)
+        assert m.max_devices == 4
+        assert set(m.buckets) == {-1, -2, -3}
+        assert m.buckets[-3].type == 11
+        assert m.buckets[-1].weights == [0x10000, 0x20000]
+        assert m.tunables.choose_total_tries == 50
+        rule = m.rules[0]
+        assert rule.steps[0] == (RuleOp.TAKE, -3, 0)
+        assert rule.steps[1] == (RuleOp.CHOOSELEAF_FIRSTN, 0, 1)
+        assert m.rule_names[0] == "replicated_rule"
+
+    def test_mapping_works_after_compile(self):
+        m = compile_text(SAMPLE)
+        weights = [0x10000] * 4
+        for x in range(64):
+            out = mapper_ref.do_rule(m, 0, x, 2, weights)
+            assert len(out) == 2
+            hosts = {o // 2 for o in out}
+            assert len(hosts) == 2  # one per host
+
+    def test_roundtrip(self):
+        m1 = compile_text(SAMPLE)
+        text = decompile(m1)
+        m2 = compile_text(text)
+        assert decompile(m2) == text
+        assert m2.buckets.keys() == m1.buckets.keys()
+        for bid in m1.buckets:
+            b1, b2 = m1.buckets[bid], m2.buckets[bid]
+            assert (b1.items, b1.weights, b1.alg, b1.type) == (
+                b2.items, b2.weights, b2.alg, b2.type
+            )
+        assert [r.steps for r in m1.rules if r] == [
+            r.steps for r in m2.rules if r
+        ]
+
+    def test_pos_reordering(self):
+        text = SAMPLE.replace(
+            "\titem osd.0 weight 1.00000\n\titem osd.1 weight 2.00000\n",
+            "\titem osd.1 weight 2.00000 pos 1\n"
+            "\titem osd.0 weight 1.00000 pos 0\n",
+        )
+        m = compile_text(text)
+        assert m.buckets[-1].items == [0, 1]
+
+    def test_errors(self):
+        with pytest.raises(CompileError):
+            compile_text("bogus syntax here")
+        with pytest.raises(CompileError):
+            compile_text("type 0 osd\nhost h { id -1 alg nope hash 0 }")
+        with pytest.raises(CompileError):
+            compile_text("tunable nonsense 3")
+
+
+class TestDeviceClasses:
+    def test_shadow_trees_built(self):
+        m = compile_text(CLASSED)
+        assert m.item_classes == {0: "hdd", 1: "ssd", 2: "hdd", 3: "ssd"}
+        # every original bucket has a shadow per class
+        for bid in (-1, -2, -3):
+            assert set(
+                m.class_names[c] for c in m.class_bucket[bid]
+            ) == {"hdd", "ssd"}
+
+    def test_class_rule_maps_only_class_devices(self):
+        m = compile_text(CLASSED)
+        weights = [0x10000] * 4
+        seen = set()
+        for x in range(128):
+            out = mapper_ref.do_rule(m, 0, x, 2, weights)
+            seen.update(out)
+            assert all(m.item_classes[o] == "ssd" for o in out)
+        assert seen == {1, 3}
+
+    def test_decompile_elides_shadows_and_prints_class(self):
+        m = compile_text(CLASSED)
+        text = decompile(m)
+        assert "~" not in text
+        assert "step take default class ssd" in text
+        m2 = compile_text(text)
+        weights = [0x10000] * 4
+        for x in range(32):
+            assert mapper_ref.do_rule(m2, 0, x, 2, weights) == \
+                mapper_ref.do_rule(m, 0, x, 2, weights)
