@@ -1,0 +1,251 @@
+"""LRC — locally repairable layered code.
+
+Semantics of the reference's lrc plugin (reference
+src/erasure-code/lrc/ErasureCodeLrc.{h,cc}): the profile describes a global
+`mapping` string plus a JSON list of layers `[[chunks_map, profile], …]`;
+each layer runs an inner code (default jerasure reed_sol_van) over its 'D'
+(data) and 'c' (coding) positions.  Decode walks the layers in reverse,
+repairing erasures with whichever layer has few enough of them — local
+layers fix single losses by reading only their group (reference
+decode_chunks :777-860).
+
+The k/m/l shorthand (profile k,m,l without mapping/layers) generates the
+classic one-global + per-group-local layout (reference parse_kml :293-395).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
+
+
+class _Layer:
+    def __init__(self, chunks_map: str, profile: dict):
+        self.chunks_map = chunks_map
+        self.data = [i for i, ch in enumerate(chunks_map) if ch == "D"]
+        self.coding = [i for i, ch in enumerate(chunks_map) if ch == "c"]
+        self.chunks = self.data + self.coding
+        self.chunks_set = set(self.chunks)
+        prof = dict(profile)
+        prof.setdefault("k", len(self.data))
+        prof.setdefault("m", len(self.coding))
+        prof.setdefault("plugin", "jerasure")
+        prof.setdefault("technique", "reed_sol_van")
+        from ceph_tpu.ec.registry import create_erasure_code
+
+        self.code = create_erasure_code(prof)
+
+
+def generate_kml(k: int, m: int, l: int) -> tuple[str, list]:
+    """reference parse_kml: mapping + layers for the k/m/l shorthand."""
+    if l == 0 or (k + m) % l:
+        raise ErasureCodeProfileError("k + m must be a multiple of l")
+    groups = (k + m) // l
+    if k % groups or m % groups:
+        raise ErasureCodeProfileError(
+            "k and m must be multiples of (k + m) / l"
+        )
+    kg, mg = k // groups, m // groups
+    mapping = ("D" * kg + "_" * mg + "_") * groups
+    layers = []
+    glob = ("D" * kg + "c" * mg + "_") * groups
+    layers.append([glob, ""])
+    for i in range(groups):
+        row = ""
+        for j in range(groups):
+            row += ("D" * l + "c") if i == j else "_" * (l + 1)
+        layers.append([row, ""])
+    return mapping, layers
+
+
+class LrcCode(ErasureCode):
+    """plugin=lrc; profile: mapping+layers JSON, or k/m/l shorthand."""
+
+    def __init__(self):
+        super().__init__()
+        self.layers: list[_Layer] = []
+        self.mapping = ""
+
+    def parse(self, profile: dict) -> None:
+        self.w = 8
+        mapping = profile.get("mapping")
+        layers_desc = profile.get("layers")
+        if mapping is None and layers_desc is None:
+            k = profile.get("k")
+            m = profile.get("m")
+            l = profile.get("l")
+            if k is None or m is None or l is None:
+                raise ErasureCodeProfileError(
+                    "lrc: need mapping+layers or all of k, m, l"
+                )
+            mapping, layers = generate_kml(int(k), int(m), int(l))
+        else:
+            if mapping is None or layers_desc is None:
+                raise ErasureCodeProfileError(
+                    "lrc: mapping and layers must both be set"
+                )
+            if isinstance(layers_desc, str):
+                try:
+                    layers = json.loads(layers_desc)
+                except json.JSONDecodeError as e:
+                    raise ErasureCodeProfileError(
+                        f"lrc: layers is not valid JSON: {e}"
+                    )
+            else:
+                layers = layers_desc
+        self.mapping = mapping
+        self.k = mapping.count("D")
+        self.m = len(mapping) - self.k
+        self.layers = []
+        for entry in layers:
+            if not isinstance(entry, (list, tuple)) or not entry:
+                raise ErasureCodeProfileError(
+                    "lrc: each layer must be [chunks_map, profile]"
+                )
+            cm = entry[0]
+            if len(cm) != len(mapping):
+                raise ErasureCodeProfileError(
+                    f"lrc: layer map {cm!r} length != mapping length "
+                    f"{len(mapping)}"
+                )
+            lp = entry[1] if len(entry) > 1 else ""
+            if isinstance(lp, str):
+                lpd: dict = {}
+                for tok in lp.split():
+                    key, _, v = tok.partition("=")
+                    lpd[key] = v
+            else:
+                lpd = dict(lp)
+            self.layers.append(_Layer(cm, lpd))
+        if not self.layers:
+            raise ErasureCodeProfileError("lrc: at least one layer needed")
+        # chunk_mapping from the global mapping: D positions then the rest
+        self.chunk_mapping = [
+            i for i, ch in enumerate(mapping) if ch == "D"
+        ] + [i for i, ch in enumerate(mapping) if ch != "D"]
+
+    def get_chunk_count(self) -> int:
+        return len(self.mapping)
+
+    def get_coding_chunk_count(self) -> int:
+        return self.get_chunk_count() - self.k
+
+    def get_alignment(self) -> int:
+        return self.k * self.w * 4
+
+    # -- encode ------------------------------------------------------------
+    def encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        """data rows are the k 'D' positions in mapping order; returns all
+        chunk positions [chunk_count, cs]."""
+        n = self.get_chunk_count()
+        cs = data.shape[1]
+        buf = np.zeros((n, cs), np.uint8)
+        dpos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        for row, pos in enumerate(dpos):
+            buf[pos] = data[row]
+        for layer in self.layers:
+            sub = np.stack([buf[c] for c in layer.chunks])
+            enc = layer.code.encode_chunks(sub[: len(layer.data)])
+            for j, c in enumerate(layer.chunks):
+                buf[c] = enc[j]
+        # external order: mapping positions as-is (the caller reads
+        # data chunks through chunk_mapping)
+        return buf
+
+    def encode(self, want_to_encode, data):
+        chunks = self.encode_prepare(data)
+        encoded = self.encode_chunks(chunks)
+        return {i: encoded[i] for i in want_to_encode}
+
+    def encode_prepare(self, data) -> np.ndarray:
+        buf = np.frombuffer(bytes(data), np.uint8)
+        cs = self.get_chunk_size(len(buf))
+        out = np.zeros((self.k, cs), np.uint8)
+        out.reshape(-1)[: len(buf)] = buf
+        return out
+
+    # -- decode ------------------------------------------------------------
+    def decode_chunks(
+        self,
+        want_to_read: set[int],
+        chunks: dict[int, np.ndarray],
+        chunk_size: int,
+    ) -> dict[int, np.ndarray]:
+        n = self.get_chunk_count()
+        decoded = {
+            i: (
+                np.asarray(chunks[i], np.uint8).copy()
+                if i in chunks
+                else np.zeros(chunk_size, np.uint8)
+            )
+            for i in range(n)
+        }
+        erasures = {i for i in range(n) if i not in chunks}
+        want_missing = want_to_read & erasures
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_set & erasures
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) > len(layer.coding):
+                continue  # too many for this layer
+            sub_chunks = {
+                j: decoded[c]
+                for j, c in enumerate(layer.chunks)
+                if c not in erasures
+            }
+            try:
+                sub = layer.code.decode_chunks(
+                    set(range(len(layer.chunks))), sub_chunks, chunk_size
+                )
+            except (ValueError, np.linalg.LinAlgError):
+                continue
+            for j, c in enumerate(layer.chunks):
+                decoded[c] = np.asarray(sub[j], np.uint8)
+                erasures.discard(c)
+            want_missing = want_to_read & erasures
+            if not want_missing:
+                break
+        if want_missing:
+            raise ValueError(
+                f"lrc: unable to read {sorted(want_missing)} from "
+                f"{sorted(chunks)}"
+            )
+        return decoded
+
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        """reference minimum_to_decode: prefer the single layer that can
+        repair the erasures locally (reference ErasureCodeLrc.cc:560-730,
+        condensed: smallest covering layer wins)."""
+        if want_to_read <= available:
+            return set(want_to_read)
+        erasures = want_to_read - available
+        best: set[int] | None = None
+        for layer in self.layers:
+            if not (erasures <= layer.chunks_set):
+                continue
+            layer_av = layer.chunks_set & available
+            layer_er = layer.chunks_set - available
+            if len(layer_er) > len(layer.coding):
+                continue
+            need = layer_av
+            if best is None or len(need) < len(best):
+                best = set(need)
+        if best is None:
+            # fall back: everything available (multi-layer decode)
+            if len(available) < self.k:
+                raise ValueError("lrc: not enough chunks")
+            return set(available)
+        return best | (want_to_read & available)
+
+    def decode_concat(self, chunks: dict[int, np.ndarray]) -> bytes:
+        dpos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        cs = len(np.asarray(next(iter(chunks.values()))).reshape(-1))
+        out = self.decode(set(dpos), chunks, cs)
+        return b"".join(
+            np.asarray(out[i], np.uint8).tobytes() for i in dpos
+        )

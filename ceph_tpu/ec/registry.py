@@ -82,13 +82,26 @@ def _make_clay(profile: dict) -> ErasureCode:
     return ClayCode()
 
 
+def _make_shec(profile: dict) -> ErasureCode:
+    from ceph_tpu.ec.shec import ShecCode
+
+    return ShecCode()
+
+
+def _make_lrc(profile: dict) -> ErasureCode:
+    from ceph_tpu.ec.lrc import LrcCode
+
+    return LrcCode()
+
+
 _PLUGINS = {
     "jerasure": _make_jerasure,
     "isa": _make_isa,
     "jax": _make_jax,
     "example": lambda p: XorExample(),
     "clay": _make_clay,
-    # shec / lrc register here once implemented
+    "shec": _make_shec,
+    "lrc": _make_lrc,
 }
 
 
