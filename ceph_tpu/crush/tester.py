@@ -60,6 +60,27 @@ class CrushTester:
                 self.weight[osd] = w
 
     # -- mapping backends --------------------------------------------------
+    def _real_xs(self, xs: np.ndarray) -> np.ndarray:
+        """pool-seed mix of the x range (CrushTester.cc:621)."""
+        if self.cfg.pool_id == -1:
+            return xs.astype(np.uint32)
+        return np.asarray(
+            crush_hash32_2(
+                xs.astype(np.uint32),
+                np.uint32(self.cfg.pool_id & 0xFFFFFFFF),
+            )
+        )
+
+    @staticmethod
+    def _rows_from_padded(padded: np.ndarray, rule) -> list[list[int]]:
+        """firstn rules compact ITEM_NONE away; indep keep positions."""
+        return [
+            [o for o in row if o != ITEM_NONE]
+            if rule.type == 1
+            else list(row)
+            for row in padded.tolist()
+        ]
+
     def _map_batch_jax(self, ruleno: int, xs: np.ndarray, nr: int):
         from ceph_tpu.utils import ensure_jax_backend
 
@@ -140,6 +161,16 @@ class CrushTester:
                         self._random_placement(rng, nr) for _ in range(n_x)
                     ]
                     prefix = "RNG"
+                elif cfg.backend == "native":
+                    from ceph_tpu.native.mapper import NativeMapper
+
+                    if getattr(self, "_nm", None) is None:
+                        self._nm = NativeMapper(m)
+                    padded = self._nm.map_batch(
+                        r, self._real_xs(xs), nr, self.weight
+                    )
+                    rows = self._rows_from_padded(padded, rule)
+                    prefix = "CRUSH"
                 elif cfg.backend == "ref":
                     real = (
                         xs
@@ -154,23 +185,8 @@ class CrushTester:
                     ]
                     prefix = "CRUSH"
                 else:
-                    real = (
-                        xs.astype(np.uint32)
-                        if cfg.pool_id == -1
-                        else np.asarray(
-                            crush_hash32_2(
-                                xs.astype(np.uint32),
-                                np.uint32(cfg.pool_id & 0xFFFFFFFF),
-                            )
-                        )
-                    )
-                    padded = self._map_batch_jax(r, real, nr)
-                    rows = [
-                        [o for o in row if o != ITEM_NONE]
-                        if rule.type == 1
-                        else list(row)
-                        for row in padded.tolist()
-                    ]
+                    padded = self._map_batch_jax(r, self._real_xs(xs), nr)
+                    rows = self._rows_from_padded(padded, rule)
                     prefix = "CRUSH"
                 for x, out_row in zip(xs, rows):
                     if cfg.show_mappings:

@@ -155,17 +155,7 @@ class LrcCode(ErasureCode):
         # data chunks through chunk_mapping)
         return buf
 
-    def encode(self, want_to_encode, data):
-        chunks = self.encode_prepare(data)
-        encoded = self.encode_chunks(chunks)
-        return {i: encoded[i] for i in want_to_encode}
-
-    def encode_prepare(self, data) -> np.ndarray:
-        buf = np.frombuffer(bytes(data), np.uint8)
-        cs = self.get_chunk_size(len(buf))
-        out = np.zeros((self.k, cs), np.uint8)
-        out.reshape(-1)[: len(buf)] = buf
-        return out
+    # encode()/encode_prepare() come from the base class (k 'D' rows)
 
     # -- decode ------------------------------------------------------------
     def decode_chunks(
@@ -185,35 +175,58 @@ class LrcCode(ErasureCode):
         }
         erasures = {i for i in range(n) if i not in chunks}
         want_missing = want_to_read & erasures
-        for layer in reversed(self.layers):
-            layer_erasures = layer.chunks_set & erasures
-            if not layer_erasures:
-                continue
-            if len(layer_erasures) > len(layer.coding):
-                continue  # too many for this layer
-            sub_chunks = {
-                j: decoded[c]
-                for j, c in enumerate(layer.chunks)
-                if c not in erasures
-            }
-            try:
-                sub = layer.code.decode_chunks(
-                    set(range(len(layer.chunks))), sub_chunks, chunk_size
-                )
-            except (ValueError, np.linalg.LinAlgError):
-                continue
-            for j, c in enumerate(layer.chunks):
-                decoded[c] = np.asarray(sub[j], np.uint8)
-                erasures.discard(c)
-            want_missing = want_to_read & erasures
-            if not want_missing:
-                break
+        # sweep the layers until a fixpoint: a later sweep can use chunks
+        # an earlier layer just recovered (the reference single-passes and
+        # can miss recoverable chunks; iterating is strictly better and
+        # keeps minimum_to_decode's peeling analysis honest)
+        progress = True
+        while want_missing and progress:
+            progress = False
+            for layer in reversed(self.layers):
+                layer_erasures = layer.chunks_set & erasures
+                if not layer_erasures:
+                    continue
+                if len(layer_erasures) > len(layer.coding):
+                    continue  # too many for this layer
+                sub_chunks = {
+                    j: decoded[c]
+                    for j, c in enumerate(layer.chunks)
+                    if c not in erasures
+                }
+                try:
+                    sub = layer.code.decode_chunks(
+                        set(range(len(layer.chunks))), sub_chunks,
+                        chunk_size,
+                    )
+                except (ValueError, np.linalg.LinAlgError):
+                    continue
+                for j, c in enumerate(layer.chunks):
+                    decoded[c] = np.asarray(sub[j], np.uint8)
+                    erasures.discard(c)
+                progress = True
+                want_missing = want_to_read & erasures
+                if not want_missing:
+                    break
         if want_missing:
             raise ValueError(
                 f"lrc: unable to read {sorted(want_missing)} from "
                 f"{sorted(chunks)}"
             )
         return decoded
+
+    def _peel_recoverable(self, available: set[int]) -> set[int]:
+        """Fixpoint of layer-by-layer repair over chunk *sets* (no data):
+        which chunks decode_chunks would eventually recover."""
+        have = set(available)
+        changed = True
+        while changed:
+            changed = False
+            for layer in self.layers:
+                missing = layer.chunks_set - have
+                if missing and len(missing) <= len(layer.coding):
+                    have |= layer.chunks_set
+                    changed = True
+        return have
 
     def minimum_to_decode(
         self, want_to_read: set[int], available: set[int]
@@ -236,9 +249,13 @@ class LrcCode(ErasureCode):
             if best is None or len(need) < len(best):
                 best = set(need)
         if best is None:
-            # fall back: everything available (multi-layer decode)
-            if len(available) < self.k:
-                raise ValueError("lrc: not enough chunks")
+            # multi-layer decode: only claim sufficiency if the peeling
+            # fixpoint actually reaches the wanted chunks
+            if not (want_to_read <= self._peel_recoverable(available)):
+                raise ValueError(
+                    f"lrc: want {sorted(want_to_read)} unrecoverable "
+                    f"from {sorted(available)}"
+                )
             return set(available)
         return best | (want_to_read & available)
 

@@ -25,7 +25,37 @@ class NumpyEngine:
         return gf_matvec_data(M, data)
 
 
-_ENGINES = {"numpy": NumpyEngine}
+class NativeEngine:
+    """C++ SIMD GF engine (ceph_tpu/native/gf.cpp)."""
+
+    def __init__(self):
+        import ctypes
+
+        from ceph_tpu.native import load_gf
+
+        lib = load_gf()
+        if lib is None:
+            raise ErasureCodeProfileError(
+                "native GF library unavailable (no C++ compiler?)"
+            )
+        self.lib = lib
+        self._u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    def matmul(self, M: np.ndarray, data: np.ndarray) -> np.ndarray:
+        M = np.ascontiguousarray(M, np.uint8)
+        data = np.ascontiguousarray(data, np.uint8)
+        m, k = M.shape
+        L = data.shape[1]
+        out = np.empty((m, L), np.uint8)
+        self.lib.gf_native_matvec(
+            M.ctypes.data_as(self._u8p), m, k,
+            data.ctypes.data_as(self._u8p),
+            out.ctypes.data_as(self._u8p), L,
+        )
+        return out
+
+
+_ENGINES = {"numpy": NumpyEngine, "native": NativeEngine}
 
 
 def get_engine(name: str):

@@ -228,26 +228,39 @@ class ShecCode(ErasureCode):
         self, want_to_read: set[int], available: set[int]
     ) -> set[int]:
         """Prefer the smallest shingle read (the point of SHEC) instead of
-        the base first-k rule."""
+        the base first-k rule.  Mirrors decode_chunks' planning exactly so
+        the returned set is guaranteed decodable: re-encoding a wanted
+        erased parity chunk needs the *full* data vector, so every erased
+        data chunk becomes an unknown in that case."""
         if want_to_read <= available:
             return set(want_to_read)
-        erased_data = {
-            i for i in want_to_read if i < self.k and i not in available
+        erased_data = {i for i in range(self.k) if i not in available}
+        want_parity = {
+            r for r in range(self.m)
+            if (self.k + r) in want_to_read
+            and (self.k + r) not in available
         }
-        if not erased_data:
-            return super().minimum_to_decode(want_to_read, available)
+        wanted = (
+            set(erased_data)
+            if want_parity
+            else (want_to_read & erased_data)
+        )
         avail_parity = [
             r for r in range(self.m) if (self.k + r) in available
         ]
         known = {i for i in range(self.k) if i in available}
+        base = want_to_read & available
+        if want_parity:
+            base = base | known  # re-encode reads all surviving data
+        if not wanted:
+            # only parity wanted, all data present: read all data
+            if want_parity:
+                return base
+            return super().minimum_to_decode(want_to_read, available)
         for _, rows, _, need, _ in self._plans(
-            erased_data, avail_parity, known
+            wanted, avail_parity, known
         ):
-            return (
-                set(need)
-                | {self.k + r for r in rows}
-                | (want_to_read & available)
-            )
+            return set(need) | {self.k + r for r in rows} | base
         raise ValueError(
             f"shec: cannot satisfy want={sorted(want_to_read)} from "
             f"available={sorted(available)}"

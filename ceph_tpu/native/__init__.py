@@ -1,0 +1,118 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+Two libraries (see the .cpp files for the design notes):
+- libceph_tpu_gf:    GF(2^8) SIMD region kernels (the missing isa-l /
+                     gf-complete role) — backs the "native" EC engine.
+- libceph_tpu_crush: threaded batch CRUSH mapper (the ParallelPGMapper
+                     role) — the fast host backend for the CLIs.
+
+Both are optional: if no C++ compiler is available the callers fall back to
+the numpy / Python paths.  Build artifacts are cached in
+ceph_tpu/native/build/ (gitignored).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+BUILD = HERE / "build"
+
+_cache: dict[str, ctypes.CDLL | None] = {}
+
+
+def _compile(name: str, src: Path, extra: list[str]) -> Path | None:
+    so = BUILD / f"lib{name}.so"
+    if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+        return so
+    BUILD.mkdir(exist_ok=True)
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [
+        cxx, "-O3", "-std=c++17", "-fPIC", "-shared",
+        *extra, str(src), "-o", str(so),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return None
+    return so
+
+
+def _load(name: str, src: str, extra: list[str]) -> ctypes.CDLL | None:
+    if name in _cache:
+        return _cache[name]
+    so = _compile(name, HERE / src, extra)
+    lib = ctypes.CDLL(str(so)) if so else None
+    _cache[name] = lib
+    return lib
+
+
+def _native_march_flags() -> list[str]:
+    # -march=native gives the SIMD paths; fall back if unsupported
+    return ["-march=native"]
+
+
+def load_gf() -> ctypes.CDLL | None:
+    lib = _load("ceph_tpu_gf", "gf.cpp", _native_march_flags())
+    if lib is None:
+        lib = _load("ceph_tpu_gf_plain", "gf.cpp", [])
+    if lib is None:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.gf_native_simd_level.restype = ctypes.c_int
+    lib.gf_native_matvec.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_longlong,
+    ]
+    lib.gf_native_mul_region.argtypes = [
+        ctypes.c_int, u8p, u8p, ctypes.c_longlong, ctypes.c_int,
+    ]
+    return lib
+
+
+def load_crush() -> ctypes.CDLL | None:
+    lib = _load("ceph_tpu_crush", "crush.cpp", ["-pthread"])
+    if lib is None:
+        return None
+    ip = ctypes.POINTER(ctypes.c_int)
+    up = ctypes.POINTER(ctypes.c_uint)
+    llp = ctypes.POINTER(ctypes.c_longlong)
+    lib.cm_set_ln_tables.argtypes = [llp, llp]
+    lib.cm_create.restype = ctypes.c_void_p
+    lib.cm_create.argtypes = [ctypes.c_int] * 6
+    lib.cm_add_bucket.restype = ctypes.c_int
+    lib.cm_add_bucket.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ip, ip, ip, ip, ctypes.c_int, ip,
+    ]
+    lib.cm_add_rule.restype = ctypes.c_int
+    lib.cm_add_rule.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ip, ip, ip,
+    ]
+    lib.cm_set_choose_args.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, up, ip, ctypes.c_int,
+    ]
+    lib.cm_set_max_devices.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.cm_map_batch.restype = ctypes.c_longlong
+    lib.cm_map_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, up, ctypes.c_longlong, ctypes.c_int,
+        up, ctypes.c_int, ip, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.cm_destroy.argtypes = [ctypes.c_void_p]
+
+    # inject the fixed-point log tables once
+    import numpy as np
+
+    from ceph_tpu.core.lntable import LL_TBL, RH_LH_TBL
+
+    rh = np.ascontiguousarray(RH_LH_TBL, dtype=np.int64)
+    ll = np.ascontiguousarray(LL_TBL, dtype=np.int64)
+    lib.cm_set_ln_tables(
+        rh.ctypes.data_as(llp), ll.ctypes.data_as(llp)
+    )
+    lib._ln_keepalive = (rh, ll)
+    return lib

@@ -1,0 +1,117 @@
+"""Python wrapper over the native threaded CRUSH mapper."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ceph_tpu.crush.types import CrushMap, ITEM_NONE
+from ceph_tpu.native import load_crush
+
+_IP = ctypes.POINTER(ctypes.c_int)
+_UP = ctypes.POINTER(ctypes.c_uint)
+
+
+def available() -> bool:
+    return load_crush() is not None
+
+
+class NativeMapper:
+    """Mirror a CrushMap into the C++ engine; map batches across threads.
+
+    The native analogue of PoolMapper's rule kernel: same semantics as
+    ceph_tpu.crush.mapper_ref.do_rule (differentially tested), used as the
+    multicore host backend and CPU baseline.
+    """
+
+    def __init__(self, m: CrushMap, choose_args=None):
+        lib = load_crush()
+        if lib is None:
+            raise RuntimeError("native crush library unavailable")
+        self.lib = lib
+        t = m.tunables
+        self.h = lib.cm_create(
+            t.choose_local_tries, t.choose_local_fallback_tries,
+            t.choose_total_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable,
+        )
+        for bid in sorted(m.buckets, reverse=True):
+            b = m.buckets[bid]
+            n = b.size
+
+            def arr(vals):
+                if vals is None:
+                    return None
+                a = (ctypes.c_int * len(vals))(*[int(v) for v in vals])
+                return ctypes.cast(a, _IP)
+
+            nodes = b.node_weights
+            lib.cm_add_bucket(
+                self.h, bid, int(b.alg), b.type, n,
+                arr(b.items), arr(b.weights), arr(b.sum_weights),
+                arr(nodes), len(nodes) if nodes else 0, arr(b.straws),
+            )
+        for ruleno, r in enumerate(m.rules):
+            if r is None:
+                continue
+            ns = len(r.steps)
+            ops = (ctypes.c_int * ns)(*[int(op) for op, _, _ in r.steps])
+            a1 = (ctypes.c_int * ns)(*[a for _, a, _ in r.steps])
+            a2 = (ctypes.c_int * ns)(*[a for _, _, a in r.steps])
+            lib.cm_add_rule(
+                self.h, ruleno, r.ruleset, r.type, r.min_size, r.max_size,
+                ns, ctypes.cast(ops, _IP), ctypes.cast(a1, _IP),
+                ctypes.cast(a2, _IP),
+            )
+        lib.cm_set_max_devices(self.h, m.max_devices)
+        # mirror one ChooseArgs set (per-bucket weight-set overrides)
+        self.has_choose_args = False
+        if choose_args is not None:
+            for bid, ws in choose_args.weight_sets.items():
+                b = m.buckets.get(bid)
+                if b is None or not ws:
+                    continue
+                positions = len(ws)
+                flat = [int(w) for row in ws for w in row]
+                wa = (ctypes.c_uint * len(flat))(*flat)
+                ids = choose_args.ids.get(bid)
+                ia = (
+                    ctypes.cast(
+                        (ctypes.c_int * len(ids))(*ids), _IP
+                    )
+                    if ids
+                    else None
+                )
+                lib.cm_set_choose_args(
+                    self.h, bid, positions, ctypes.cast(wa, _UP), ia,
+                    b.size,
+                )
+                self.has_choose_args = True
+
+    def map_batch(
+        self,
+        ruleno: int,
+        xs: np.ndarray,
+        result_max: int,
+        weights: list[int] | np.ndarray,
+        n_threads: int = 0,
+    ) -> np.ndarray:
+        """-> int32[n, result_max], ITEM_NONE padded."""
+        xs = np.ascontiguousarray(xs, dtype=np.uint32)
+        w = np.ascontiguousarray(weights, dtype=np.uint32)
+        out = np.full((len(xs), result_max), ITEM_NONE, np.int32)
+        self.lib.cm_map_batch(
+            self.h, ruleno,
+            xs.ctypes.data_as(_UP), len(xs), result_max,
+            w.ctypes.data_as(_UP), len(w),
+            out.ctypes.data_as(_IP), n_threads,
+            1 if self.has_choose_args else 0,
+        )
+        return out
+
+    def __del__(self):
+        try:
+            self.lib.cm_destroy(self.h)
+        except Exception:
+            pass

@@ -1,0 +1,157 @@
+"""Native C++ component tests: GF SIMD kernels vs the numpy tables, and the
+threaded batch CRUSH mapper vs the Python semantic oracle."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.types import BucketAlg, Tunables
+from util_maps import build_flat, build_tree
+
+
+def _gf_lib():
+    from ceph_tpu.native import load_gf
+
+    lib = load_gf()
+    if lib is None:
+        pytest.skip("no C++ toolchain for native GF library")
+    return lib
+
+
+def _native_mapper():
+    from ceph_tpu.native import mapper
+
+    if not mapper.available():
+        pytest.skip("no C++ toolchain for native crush library")
+    return mapper
+
+
+class TestNativeGF:
+    def test_matvec_matches_numpy(self, rng):
+        _gf_lib()
+        from ceph_tpu.ec.matrices import vandermonde_rs
+        from ceph_tpu.ec.rs import NativeEngine, NumpyEngine
+
+        M = vandermonde_rs(8, 4)
+        data = rng.integers(0, 256, (8, 100_000)).astype(np.uint8)
+        want = NumpyEngine().matmul(M, data)
+        got = NativeEngine().matmul(M, data)
+        assert np.array_equal(want, got)
+
+    def test_mul_region(self, rng):
+        import ctypes
+
+        lib = _gf_lib()
+        from ceph_tpu.ec.gf import GF_MUL_TABLE
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        src = rng.integers(0, 256, 1000).astype(np.uint8)
+        dst = np.zeros(1000, np.uint8)
+        for c in (0, 1, 2, 0x53, 255):
+            lib.gf_native_mul_region(
+                c, src.ctypes.data_as(u8p), dst.ctypes.data_as(u8p),
+                1000, 0,
+            )
+            assert np.array_equal(dst, GF_MUL_TABLE[c, src]), c
+
+    def test_native_plugin_roundtrip(self, rng):
+        _gf_lib()
+        from ceph_tpu.ec import create_erasure_code
+
+        code = create_erasure_code(
+            {"plugin": "jerasure", "k": 5, "m": 3, "backend": "native"}
+        )
+        data = rng.integers(0, 256, 4000).astype(np.uint8).tobytes()
+        enc = code.encode(set(range(8)), data)
+        del enc[0], enc[4], enc[7]
+        assert code.decode_concat(enc)[:4000] == data
+
+
+class TestNativeCrush:
+    @pytest.mark.parametrize(
+        "alg", [BucketAlg.STRAW2, BucketAlg.STRAW, BucketAlg.LIST,
+                BucketAlg.TREE, BucketAlg.UNIFORM]
+    )
+    def test_flat_map_matches_ref(self, alg, rng):
+        mapper = _native_mapper()
+        m, root = build_flat(16, alg=alg)
+        ruleno = m.make_replicated_rule(root, 0)
+        nm = mapper.NativeMapper(m)
+        weights = [0x10000] * 16
+        xs = np.arange(400, dtype=np.uint32)
+        out = nm.map_batch(ruleno, xs, 3, weights)
+        for x in range(400):
+            want = mapper_ref.do_rule(m, ruleno, x, 3, weights)
+            got = [o for o in out[x] if o != 0x7FFFFFFF]
+            assert got == want, (alg, x)
+
+    @pytest.mark.parametrize("mode", ["firstn", "indep"])
+    def test_hierarchy_matches_ref(self, mode, rng):
+        mapper = _native_mapper()
+        m, root = build_tree(rng, n_host=8, osd_per_host=4)
+        if mode == "firstn":
+            ruleno = m.make_replicated_rule(root, 1)
+        else:
+            ruleno = m.make_erasure_rule(root, 1)
+        weights = [0x10000] * 32
+        # include some down-weighted and out devices
+        weights[3] = 0
+        weights[17] = 0x8000
+        nm = mapper.NativeMapper(m)
+        xs = np.arange(600, dtype=np.uint32)
+        out = nm.map_batch(ruleno, xs, 4, weights)
+        for x in range(600):
+            want = mapper_ref.do_rule(m, ruleno, x, 4, weights)
+            if mode == "firstn":
+                got = [o for o in out[x] if o != 0x7FFFFFFF]
+            else:
+                got = list(out[x][: len(want)])
+            assert got == want, (mode, x)
+
+    def test_legacy_tunables(self, rng):
+        mapper = _native_mapper()
+        t = Tunables.profile("bobtail")
+        m, root = build_tree(rng, n_host=4, osd_per_host=4, tunables=t)
+        ruleno = m.make_replicated_rule(root, 1)
+        weights = [0x10000] * 16
+        nm = mapper.NativeMapper(m)
+        out = nm.map_batch(
+            ruleno, np.arange(200, dtype=np.uint32), 3, weights
+        )
+        for x in range(200):
+            want = mapper_ref.do_rule(m, ruleno, x, 3, weights)
+            got = [o for o in out[x] if o != 0x7FFFFFFF]
+            assert got == want, x
+
+    def test_multithreaded_equals_single(self, rng):
+        mapper = _native_mapper()
+        m, root = build_tree(rng, n_host=8, osd_per_host=4)
+        ruleno = m.make_replicated_rule(root, 1)
+        weights = [0x10000] * 32
+        nm = mapper.NativeMapper(m)
+        xs = np.arange(5000, dtype=np.uint32)
+        a = nm.map_batch(ruleno, xs, 3, weights, n_threads=1)
+        b = nm.map_batch(ruleno, xs, 3, weights, n_threads=8)
+        assert np.array_equal(a, b)
+
+    def test_choose_args_respected(self, rng):
+        mapper = _native_mapper()
+        from ceph_tpu.crush.types import ChooseArgs
+
+        m, root = build_flat(8)
+        ruleno = m.make_replicated_rule(root, 0)
+        ca = ChooseArgs()
+        # double the weight of osd 0 in the root bucket
+        ws = [[0x20000] + [0x10000] * 7]
+        ca.weight_sets[root] = ws
+        m.choose_args[-1] = ca
+        nm = mapper.NativeMapper(m, choose_args=ca)
+        weights = [0x10000] * 8
+        xs = np.arange(300, dtype=np.uint32)
+        out = nm.map_batch(ruleno, xs, 2, weights)
+        for x in range(300):
+            want = mapper_ref.do_rule(
+                m, ruleno, x, 2, weights, choose_args=ca
+            )
+            got = [o for o in out[x] if o != 0x7FFFFFFF]
+            assert got == want, x

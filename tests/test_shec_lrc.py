@@ -130,3 +130,54 @@ class TestLrcRoundtrip:
             have = {i: encoded[i] for i in range(n) if i != lost}
             got = code.decode({lost}, dict(have))
             assert np.array_equal(got[lost], encoded[lost]), lost
+
+
+class TestReviewRegressions:
+    def test_shec_minimum_with_wanted_parity(self, rng):
+        """minimum_to_decode must stay decodable when an erased parity is
+        wanted alongside other erased data (2 <= c erasures)."""
+        import itertools
+
+        for k, m, c in [(4, 3, 2), (6, 3, 2)]:
+            code = create_erasure_code(
+                {"plugin": "shec", "k": k, "m": m, "c": c}
+            )
+            n = k + m
+            data = np.random.default_rng(5).integers(
+                0, 256, 500
+            ).astype(np.uint8).tobytes()
+            encoded = code.encode(set(range(n)), data)
+            for lost in itertools.combinations(range(n), 2):
+                for want in lost:
+                    avail = set(range(n)) - set(lost)
+                    minimum = code.minimum_to_decode({want}, avail)
+                    have = {i: encoded[i] for i in minimum}
+                    got = code.decode({want}, have)
+                    assert np.array_equal(got[want], encoded[want]), (
+                        lost, want,
+                    )
+
+    def test_lrc_minimum_raises_when_unrecoverable(self):
+        code = create_erasure_code(
+            {
+                "plugin": "lrc",
+                "mapping": "__DD__DD",
+                "layers": '[["_cDD_cDD", ""], ["cDDD____", ""],'
+                          ' ["____cDDD", ""]]',
+            }
+        )
+        n = code.get_chunk_count()
+        # losing all of {1,2,3} exceeds every covering layer's coding
+        # capacity -> minimum_to_decode must raise, not lie
+        with pytest.raises(ValueError):
+            code.minimum_to_decode({2}, set(range(n)) - {1, 2, 3})
+        # losing a local parity + a global parity IS recoverable via the
+        # multi-sweep decode (global repairs 1, then local repairs 0)
+        minimum = code.minimum_to_decode({0, 1}, set(range(n)) - {0, 1})
+        rng = np.random.default_rng(6)
+        data = rng.integers(0, 256, 400).astype(np.uint8).tobytes()
+        encoded = code.encode(set(range(n)), data)
+        have = {i: encoded[i] for i in minimum}
+        got = code.decode({0, 1}, have)
+        assert np.array_equal(got[0], encoded[0])
+        assert np.array_equal(got[1], encoded[1])
