@@ -3,9 +3,8 @@
 Covers the reference tool's compile/decompile/build/test surface
 (reference src/tools/crushtool.cc:129-231 usage, :436-1276 arg loop):
 
-    crushtool -c map.txt -o map        compile (stored as text; binary codec
-                                       arrives with ceph_tpu.osd.codec)
-    crushtool -d map [-o out.txt]      decompile
+    crushtool -c map.txt -o map        compile to the wire-format binary
+    crushtool -d map [-o out.txt]      decompile (binary or text input)
     crushtool --build --num_osds N layer1 alg size ...
     crushtool -i map --test [--min-x --max-x --num-rep --rule --pool-id
                              --weight osd w --show-statistics
@@ -22,6 +21,11 @@ from __future__ import annotations
 
 import sys
 
+from ceph_tpu.crush.codec import (
+    decode_crushmap,
+    encode_crushmap,
+    looks_like_crushmap,
+)
 from ceph_tpu.crush.compiler import compile_text, decompile
 from ceph_tpu.crush.tester import CrushTester, TesterConfig
 from ceph_tpu.crush.types import BucketAlg, CrushMap
@@ -29,8 +33,12 @@ from ceph_tpu.osd.osdmap import DEFAULT_TYPES
 
 
 def _read_map(path: str) -> CrushMap:
-    with open(path) as f:
-        return compile_text(f.read())
+    """Binary (wire format) or text, auto-detected like the real tool."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if looks_like_crushmap(data):
+        return decode_crushmap(data)
+    return compile_text(data.decode())
 
 
 def _write(path: str | None, text: str) -> None:
@@ -39,6 +47,16 @@ def _write(path: str | None, text: str) -> None:
     else:
         with open(path, "w") as f:
             f.write(text)
+
+
+def _write_map(path: str, m: CrushMap) -> None:
+    """One suffix policy everywhere: .txt -> decompiled text, else the
+    wire-format binary (what the reference tool emits)."""
+    if path.endswith(".txt"):
+        _write(path, decompile(m))
+    else:
+        with open(path, "wb") as f:
+            f.write(encode_crushmap(m))
 
 
 _ALGS = {
@@ -225,7 +243,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if compilefn:
         m = _read_map(compilefn)  # parse = validate
-        _write(outfn or "crushmap", decompile(m))
+        _write_map(outfn or "crushmap", m)
         return 0
     if do_build:
         if not num_osds or not layers:
@@ -233,7 +251,7 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         m = build_map(num_osds, layers)
         if outfn:
-            _write(outfn, decompile(m))
+            _write_map(outfn, m)
         else:
             print_tree(m)
         return 0
@@ -259,7 +277,7 @@ def main(argv: list[str] | None = None) -> int:
     if do_test:
         CrushTester(m, cfg, out=sys.stdout).test()
     if changed and outfn:
-        _write(outfn, decompile(m))
+        _write_map(outfn, m)
     return 0
 
 

@@ -172,16 +172,9 @@ class CrushTester:
                     rows = self._rows_from_padded(padded, rule)
                     prefix = "CRUSH"
                 elif cfg.backend == "ref":
-                    real = (
-                        xs
-                        if cfg.pool_id == -1
-                        else [
-                            int(crush_hash32_2(x, cfg.pool_id & 0xFFFFFFFF))
-                            for x in xs
-                        ]
-                    )
                     rows = [
-                        self._map_one_ref(r, int(rx), nr) for rx in real
+                        self._map_one_ref(r, int(rx), nr)
+                        for rx in self._real_xs(xs)
                     ]
                     prefix = "CRUSH"
                 else:

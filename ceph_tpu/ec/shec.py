@@ -253,10 +253,12 @@ class ShecCode(ErasureCode):
         if want_parity:
             base = base | known  # re-encode reads all surviving data
         if not wanted:
-            # only parity wanted, all data present: read all data
-            if want_parity:
-                return base
-            return super().minimum_to_decode(want_to_read, available)
+            # only parity wanted with all data present: read all data.
+            # (want_to_read ⊄ available guarantees want_parity here —
+            # a wanted erased chunk is either data (wanted non-empty)
+            # or parity.)
+            assert want_parity
+            return base
         for _, rows, _, need, _ in self._plans(
             wanted, avail_parity, known
         ):

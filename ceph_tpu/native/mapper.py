@@ -72,10 +72,19 @@ class NativeMapper:
                 b = m.buckets.get(bid)
                 if b is None or not ws:
                     continue
+                ids = choose_args.ids.get(bid)
+                # the C side slices flat buffers at bucket-size strides:
+                # reject mismatched rows instead of feeding it garbage
+                if any(len(row) != b.size for row in ws) or (
+                    ids is not None and len(ids) != b.size
+                ):
+                    raise ValueError(
+                        f"choose_args for bucket {bid}: weight rows/ids "
+                        f"must have exactly {b.size} entries"
+                    )
                 positions = len(ws)
                 flat = [int(w) for row in ws for w in row]
                 wa = (ctypes.c_uint * len(flat))(*flat)
-                ids = choose_args.ids.get(bid)
                 ia = (
                     ctypes.cast(
                         (ctypes.c_int * len(ids))(*ids), _IP

@@ -111,5 +111,11 @@ def save_crush_text(m: CrushMap, path: str) -> None:
 
 
 def load_crush_text(path: str) -> CrushMap:
-    with open(path) as f:
-        return compile_text(f.read())
+    """Text or binary (wire format), auto-detected."""
+    from ceph_tpu.crush.codec import decode_crushmap, looks_like_crushmap
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if looks_like_crushmap(data):
+        return decode_crushmap(data)
+    return compile_text(data.decode())

@@ -1,0 +1,3 @@
+from ceph_tpu.sim.failure import ClusterSim, MovementReport
+
+__all__ = ["ClusterSim", "MovementReport"]

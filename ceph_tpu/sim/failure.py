@@ -1,0 +1,172 @@
+"""Failure / recovery simulation over the batched placement pipeline.
+
+The reference's failure handling is declarative: heartbeats mark OSDs down
+(reference src/osd/OSD.cc:5327 handle_osd_ping, :5698 heartbeat_check),
+the monitor publishes a new epoch, and recovery IS the difference between
+the old and new up/acting sets per PG (peering/backfill,
+reference src/osd/PeeringState.cc; pg_temp keeps serving from the old
+acting set during backfill, reference src/osd/OSDMap.cc:2592).
+
+For a placement framework, that means failure simulation = flip osd state,
+re-run the batched mapping, and diff — this module does exactly that, plus
+an OSDThrasher-style randomized fault injector (the qa harness pattern,
+reference qa/tasks/ceph_manager.py:185) used by the tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgId
+
+
+@dataclass
+class MovementReport:
+    """Diff of two cluster mappings (per pool)."""
+
+    total_pgs: int = 0
+    pgs_remapped: int = 0  # up set changed
+    pgs_primary_changed: int = 0
+    replicas_moved: int = 0  # osds that entered a pg's up set
+    degraded_pgs: int = 0  # up set smaller than pool size
+    moved_fraction: float = 0.0
+
+    def merge(self, other: "MovementReport") -> None:
+        self.total_pgs += other.total_pgs
+        self.pgs_remapped += other.pgs_remapped
+        self.pgs_primary_changed += other.pgs_primary_changed
+        self.replicas_moved += other.replicas_moved
+        self.degraded_pgs += other.degraded_pgs
+        if self.total_pgs:
+            self.moved_fraction = self.pgs_remapped / self.total_pgs
+
+
+def _map_all(m: OSDMap, backend: str) -> dict[int, tuple]:
+    out = {}
+    for pid in sorted(m.pools):
+        if backend == "jax":
+            from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+            out[pid] = PoolMapper(m, pid).map_all()
+        else:
+            pool = m.pools[pid]
+            n, W = pool.pg_num, pool.size
+            up = np.full((n, W), ITEM_NONE, np.int32)
+            upp = np.full(n, -1, np.int32)
+            acting = np.full((n, W), ITEM_NONE, np.int32)
+            actp = np.full(n, -1, np.int32)
+            for ps in range(n):
+                u, up_pr, a, a_pr = m.pg_to_up_acting_osds(PgId(pid, ps))
+                up[ps, : len(u)] = u
+                acting[ps, : len(a)] = a
+                upp[ps], actp[ps] = up_pr, a_pr
+            out[pid] = (up, upp, acting, actp)
+    return out
+
+
+def diff_mappings(
+    before: dict[int, tuple], after: dict[int, tuple], pools: dict
+) -> MovementReport:
+    rep = MovementReport()
+    for pid, (up1, upp1, _, _) in before.items():
+        up2, upp2, _, _ = after[pid]
+        size = pools[pid].size
+        total = up1.shape[0]
+        rep.total_pgs += total
+        for ps in range(total):
+            a = [o for o in up1[ps] if o != ITEM_NONE]
+            b = [o for o in up2[ps] if o != ITEM_NONE]
+            if a != b:
+                rep.pgs_remapped += 1
+                rep.replicas_moved += len(set(b) - set(a))
+            if upp1[ps] != upp2[ps]:
+                rep.pgs_primary_changed += 1
+            if len(b) < size:
+                rep.degraded_pgs += 1
+    if rep.total_pgs:
+        rep.moved_fraction = rep.pgs_remapped / rep.total_pgs
+    return rep
+
+
+class ClusterSim:
+    """Stateful failure simulator: apply events, measure movement."""
+
+    def __init__(self, m: OSDMap, backend: str = "jax"):
+        self.m = m
+        self.backend = backend
+        self.epoch = m.epoch
+        self.current = _map_all(m, backend)
+        self.history: list[tuple[str, MovementReport]] = []
+
+    def _step(self, label: str) -> MovementReport:
+        self.epoch += 1
+        self.m.epoch = self.epoch
+        new = _map_all(self.m, self.backend)
+        rep = diff_mappings(self.current, new, self.m.pools)
+        self.current = new
+        self.history.append((label, rep))
+        return rep
+
+    # -- events ------------------------------------------------------------
+    def fail_osd(self, osd: int, out: bool = True) -> MovementReport:
+        """down (+out): the heartbeat-timeout → mark-down → mark-out path."""
+        self.m.mark_down(osd)
+        if out:
+            self.m.mark_out(osd)
+        return self._step(f"fail osd.{osd}")
+
+    def revive_osd(self, osd: int) -> MovementReport:
+        self.m.mark_up_in(osd)
+        return self._step(f"revive osd.{osd}")
+
+    def reweight_osd(self, osd: int, weight: float) -> MovementReport:
+        self.m.osd_weight[osd] = int(weight * 0x10000)
+        return self._step(f"reweight osd.{osd} {weight}")
+
+    def set_pg_temp(
+        self, pg: PgId, acting: list[int], primary: int = -1
+    ) -> MovementReport:
+        """Serve from the old acting set during backfill."""
+        self.m.pg_temp[pg] = list(acting)
+        if primary >= 0:
+            self.m.primary_temp[pg] = primary
+        return self._step(f"pg_temp {pg}")
+
+    def balance(self, **kw) -> MovementReport:
+        from ceph_tpu.balancer import calc_pg_upmaps
+
+        kw.setdefault("use_tpu", self.backend == "jax")
+        calc_pg_upmaps(self.m, **kw)
+        return self._step("balance")
+
+    # -- thrasher ----------------------------------------------------------
+    def thrash(
+        self,
+        rounds: int,
+        rng: np.random.Generator | None = None,
+        p_fail: float = 0.5,
+    ) -> list[MovementReport]:
+        """OSDThrasher pattern: random kill/revive rounds; every PG must
+        stay mapped (no PG falls off the cluster while >= size OSDs up)."""
+        rng = rng or np.random.default_rng(0)
+        downed: list[int] = []
+        reports = []
+        for _ in range(rounds):
+            up_osds = [
+                o for o in range(self.m.max_osd)
+                if self.m.is_up(o)
+            ]
+            if downed and (
+                rng.random() > p_fail or len(up_osds) <= 3
+            ):
+                osd = downed.pop(int(rng.integers(len(downed))))
+                reports.append(self.revive_osd(osd))
+            elif len(up_osds) > 3:
+                osd = int(up_osds[int(rng.integers(len(up_osds)))])
+                downed.append(osd)
+                reports.append(self.fail_osd(osd))
+        return reports

@@ -1,0 +1,150 @@
+"""Config/option system — declared options with layered overrides.
+
+Mirrors the reference's shape (reference src/common/options/global.yaml.in
+declares options with type/level/default/min-max/enum, code-generated into
+Option tables by y2c.py; md_config_t in src/common/config.cc layers
+defaults < conf file < env < CLI overrides and notifies observers):
+
+- options are declared in OPTIONS below (the subset this framework uses),
+- Config resolves defaults < config file (ini-ish "key = value") <
+  environment (CEPH_TPU_<KEY>) < programmatic set_val,
+- observers get (name, new_value) callbacks on live updates.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: type
+    default: Any
+    level: str = "advanced"
+    desc: str = ""
+    min: float | None = None
+    max: float | None = None
+    enum: tuple | None = None
+
+
+OPTIONS: dict[str, Option] = {
+    o.name: o
+    for o in [
+        # balancer knobs (reference common/options/global.yaml.in:
+        # osd_calc_pg_upmaps_aggressively etc., read at OSDMap.cc:4735)
+        Option("osd_calc_pg_upmaps_aggressively", bool, True,
+               desc="try harder to optimize upmaps"),
+        Option("osd_calc_pg_upmaps_local_fallback_retries", int, 100,
+               desc="candidate retries per balancer iteration"),
+        Option("upmap_max_deviation", int, 5,
+               desc="deviation below which a PG distribution is perfect"),
+        # mapper / tester
+        Option("crush_backend", str, "jax",
+               enum=("jax", "native", "ref"),
+               desc="default batched mapping backend"),
+        Option("mapper_batch_threads", int, 0,
+               desc="native mapper threads (0 = hardware)"),
+        # erasure coding
+        Option("ec_backend", str, "numpy",
+               enum=("numpy", "native", "jax"),
+               desc="default erasure-code engine"),
+        Option("osd_pool_default_size", int, 3, min=1, max=32),
+        Option("osd_pool_default_pg_num", int, 32, min=1),
+        Option("osd_crush_chooseleaf_type", int, 1,
+               desc="chooseleaf failure-domain type for simple maps"),
+        # logging
+        Option("log_level", int, 1, min=0, max=20),
+    ]
+}
+
+ENV_PREFIX = "CEPH_TPU_"
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _coerce(opt: Option, raw: Any) -> Any:
+    if isinstance(raw, str):
+        if opt.type is bool:
+            v: Any = raw.strip().lower() in ("1", "true", "yes", "on")
+        elif opt.type is int:
+            v = int(raw)
+        elif opt.type is float:
+            v = float(raw)
+        else:
+            v = raw
+    else:
+        v = opt.type(raw)
+    if opt.enum is not None and v not in opt.enum:
+        raise ConfigError(
+            f"{opt.name}={v!r} not in {opt.enum}"
+        )
+    if opt.min is not None and v < opt.min:
+        raise ConfigError(f"{opt.name}={v} < min {opt.min}")
+    if opt.max is not None and v > opt.max:
+        raise ConfigError(f"{opt.name}={v} > max {opt.max}")
+    return v
+
+
+class Config:
+    """Layered option resolution + observers."""
+
+    def __init__(self, conf_file: str | None = None, env: bool = True):
+        self._values: dict[str, Any] = {}
+        self._observers: list[Callable[[str, Any], None]] = []
+        if conf_file:
+            self.load_file(conf_file)
+        if env:
+            self._load_env()
+
+    def _load_env(self) -> None:
+        for name, opt in OPTIONS.items():
+            raw = os.environ.get(ENV_PREFIX + name.upper())
+            if raw is not None:
+                self._values[name] = _coerce(opt, raw)
+
+    def load_file(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, _, v = line.partition("=")
+                k = k.strip().replace(" ", "_")
+                if k in OPTIONS:
+                    self._values[k] = _coerce(OPTIONS[k], v.strip())
+
+    def get(self, name: str) -> Any:
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise ConfigError(f"unknown option {name!r}")
+        return self._values.get(name, opt.default)
+
+    def set_val(self, name: str, value: Any) -> None:
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise ConfigError(f"unknown option {name!r}")
+        v = _coerce(opt, value)
+        self._values[name] = v
+        for cb in self._observers:
+            cb(name, v)
+
+    def add_observer(self, cb: Callable[[str, Any], None]) -> None:
+        self._observers.append(cb)
+
+    def show_config(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in sorted(OPTIONS)}
+
+
+_global: Config | None = None
+
+
+def global_config() -> Config:
+    global _global
+    if _global is None:
+        _global = Config()
+    return _global

@@ -1,0 +1,81 @@
+"""Leveled, per-subsystem debug logging (the `dout/ldout` pattern,
+reference src/common/dout.h + per-subsystem levels in src/common/subsys.h).
+
+Usage:
+    log = subsys_logger("crush")
+    log(10, "descend into", bucket_id)   # printed iff level(crush) >= 10
+
+Levels follow the reference convention: 0/1 important, 5 normal detail,
+10/20/30 increasingly verbose internals.  Configure globally via
+set_subsys_level / CEPH_TPU_DEBUG env ("crush=10,osd=5" syntax like
+--debug-crush).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+SUBSYS_DEFAULTS = {
+    "crush": 1,
+    "osd": 1,
+    "ec": 1,
+    "balancer": 1,
+    "tester": 1,
+    "native": 1,
+    "sim": 1,
+}
+
+_levels = dict(SUBSYS_DEFAULTS)
+_out = sys.stderr
+
+
+def _parse_env() -> None:
+    spec = os.environ.get("CEPH_TPU_DEBUG", "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, lvl = part.partition("=")
+        try:
+            _levels[name.strip()] = int(lvl)
+        except ValueError:
+            pass
+
+
+_parse_env()
+
+
+def set_subsys_level(subsys: str, level: int) -> None:
+    _levels[subsys] = level
+
+
+def get_subsys_level(subsys: str) -> int:
+    return _levels.get(subsys, 1)
+
+
+def set_output(stream) -> None:
+    global _out
+    _out = stream
+
+
+class subsys_logger:
+    __slots__ = ("subsys",)
+
+    def __init__(self, subsys: str):
+        if subsys not in _levels:
+            _levels[subsys] = 1
+        self.subsys = subsys
+
+    def __call__(self, level: int, *args) -> None:
+        if level <= _levels.get(self.subsys, 1):
+            ts = time.strftime("%H:%M:%S")
+            print(
+                f"{ts} {level:2d} {self.subsys}:",
+                *args,
+                file=_out,
+            )
+
+    def enabled(self, level: int) -> bool:
+        return level <= _levels.get(self.subsys, 1)

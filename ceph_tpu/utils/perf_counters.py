@@ -1,0 +1,136 @@
+"""Perf counters — in-process metrics, dumpable as JSON.
+
+Mirrors the reference's per-daemon counter surface
+(reference src/common/perf_counters.h: u64 counters, u64 averages
+(sum+count pairs), time averages, histograms; exposed by `ceph daemon
+<sock> perf dump` via the admin socket, reference
+src/common/admin_socket.cc).  Here: a registry of named counters with the
+same shapes, a `dump()` that matches the perf-dump JSON layout, and a
+`logger_for` helper the hot paths use.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Counter:
+    kind: str  # u64 | avg | time_avg | histogram
+    value: int = 0
+    sum: float = 0.0
+    count: int = 0
+    buckets: list[int] = field(default_factory=list)
+    bucket_bounds: list[float] = field(default_factory=list)
+    desc: str = ""
+
+
+class PerfCounters:
+    """One named group of counters (a daemon's `logger` equivalent)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._c: dict[str, _Counter] = {}
+        self._lock = threading.Lock()
+
+    # -- declaration -------------------------------------------------------
+    def add_u64(self, key: str, desc: str = "") -> None:
+        self._c[key] = _Counter("u64", desc=desc)
+
+    def add_avg(self, key: str, desc: str = "") -> None:
+        self._c[key] = _Counter("avg", desc=desc)
+
+    def add_time_avg(self, key: str, desc: str = "") -> None:
+        self._c[key] = _Counter("time_avg", desc=desc)
+
+    def add_histogram(
+        self, key: str, bounds: list[float], desc: str = ""
+    ) -> None:
+        c = _Counter("histogram", desc=desc)
+        c.bucket_bounds = list(bounds)
+        c.buckets = [0] * (len(bounds) + 1)
+        self._c[key] = c
+
+    # -- updates -----------------------------------------------------------
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[key].value += n
+
+    def set(self, key: str, v: int) -> None:
+        with self._lock:
+            self._c[key].value = v
+
+    def observe(self, key: str, v: float) -> None:
+        with self._lock:
+            c = self._c[key]
+            if c.kind == "histogram":
+                i = 0
+                while i < len(c.bucket_bounds) and v > c.bucket_bounds[i]:
+                    i += 1
+                c.buckets[i] += 1
+            c.sum += v
+            c.count += 1
+
+    def time(self, key: str):
+        """Context manager recording elapsed seconds into a time_avg."""
+        pc = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                pc.observe(key, time.perf_counter() - self.t0)
+                return False
+
+        return _T()
+
+    # -- dump (perf-dump JSON layout) ---------------------------------------
+    def dump(self) -> dict:
+        out: dict = {}
+        with self._lock:
+            for key, c in self._c.items():
+                if c.kind == "u64":
+                    out[key] = c.value
+                elif c.kind in ("avg", "time_avg"):
+                    out[key] = {
+                        "avgcount": c.count,
+                        "sum": c.sum,
+                        "avgtime" if c.kind == "time_avg" else "avg": (
+                            c.sum / c.count if c.count else 0.0
+                        ),
+                    }
+                else:
+                    out[key] = {
+                        "bounds": c.bucket_bounds,
+                        "buckets": list(c.buckets),
+                        "sum": c.sum,
+                        "count": c.count,
+                    }
+        return out
+
+
+_registry: dict[str, PerfCounters] = {}
+_registry_lock = threading.Lock()
+
+
+def logger_for(name: str) -> PerfCounters:
+    with _registry_lock:
+        pc = _registry.get(name)
+        if pc is None:
+            pc = _registry[name] = PerfCounters(name)
+        return pc
+
+
+def perf_dump() -> dict:
+    """All groups — the `ceph daemon ... perf dump` shape."""
+    with _registry_lock:
+        return {name: pc.dump() for name, pc in _registry.items()}
+
+
+def reset() -> None:
+    with _registry_lock:
+        _registry.clear()

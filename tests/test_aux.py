@@ -1,0 +1,149 @@
+"""Aux subsystem tests: failure/recovery sim + thrasher, perf counters,
+config layering, leveled logging (SURVEY §5 coverage)."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.osdmap import build_hierarchical
+from ceph_tpu.osd.types import PgPool, PoolType
+from ceph_tpu.sim import ClusterSim
+
+
+def _map(pg_num=128):
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=pg_num, pgp_num=pg_num)
+    return build_hierarchical(6, 4, pool=pool)
+
+
+class TestClusterSim:
+    def test_failure_moves_little_and_no_failed_target(self):
+        m = _map()
+        sim = ClusterSim(m, backend="ref")
+        rep = sim.fail_osd(5)
+        # failed osd never appears in the new mapping
+        up, _, _, _ = sim.current[0]
+        assert not (up == 5).any()
+        # CRUSH property: movement proportional to lost capacity (~1/24)
+        assert 0 < rep.moved_fraction < 0.35
+        assert rep.degraded_pgs == 0  # enough osds to re-place
+
+    def test_down_not_out_degrades(self):
+        m = _map()
+        sim = ClusterSim(m, backend="ref")
+        rep = sim.fail_osd(5, out=False)  # down but still "in"
+        assert rep.degraded_pgs > 0  # holes until marked out
+
+    def test_revival_restores_mapping(self):
+        m = _map()
+        sim = ClusterSim(m, backend="ref")
+        before = {
+            ps: list(sim.current[0][0][ps]) for ps in range(128)
+        }
+        sim.fail_osd(7)
+        rep = sim.revive_osd(7)
+        after = {ps: list(sim.current[0][0][ps]) for ps in range(128)}
+        assert before == after  # CRUSH determinism: full restoration
+        assert rep.pgs_remapped > 0
+
+    def test_thrasher_keeps_cluster_mapped(self):
+        m = _map(pg_num=64)
+        sim = ClusterSim(m, backend="ref")
+        reports = sim.thrash(8, rng=np.random.default_rng(3))
+        assert len(reports) == 8
+        up, _, _, _ = sim.current[0]
+        # every PG still has at least one live replica
+        from ceph_tpu.crush.types import ITEM_NONE
+
+        for ps in range(64):
+            assert any(o != ITEM_NONE for o in up[ps]), ps
+
+    def test_pg_temp_overrides_acting(self):
+        from ceph_tpu.osd.types import PgId
+
+        m = _map(pg_num=32)
+        sim = ClusterSim(m, backend="ref")
+        up0 = [o for o in sim.current[0][0][0] if o != 0x7FFFFFFF]
+        tmp = [o for o in range(3)]
+        sim.set_pg_temp(PgId(0, 0), tmp, primary=tmp[1])
+        _, _, acting, actp = sim.current[0]
+        assert list(acting[0][:3]) == tmp
+        assert actp[0] == tmp[1]
+
+
+class TestPerfCounters:
+    def test_counters_and_dump(self):
+        from ceph_tpu.utils import perf_counters as pc
+
+        pc.reset()
+        log = pc.logger_for("crush")
+        log.add_u64("mappings", "total mappings")
+        log.add_time_avg("map_latency")
+        log.add_histogram("batch_size", [10, 100, 1000])
+        log.inc("mappings", 42)
+        with log.time("map_latency"):
+            pass
+        log.observe("batch_size", 50)
+        log.observe("batch_size", 5000)
+        d = pc.perf_dump()
+        assert d["crush"]["mappings"] == 42
+        assert d["crush"]["map_latency"]["avgcount"] == 1
+        assert d["crush"]["batch_size"]["buckets"] == [0, 1, 0, 1]
+        json.dumps(d)  # must be serializable
+
+    def test_registry_reuse(self):
+        from ceph_tpu.utils import perf_counters as pc
+
+        pc.reset()
+        a = pc.logger_for("x")
+        b = pc.logger_for("x")
+        assert a is b
+
+
+class TestConfig:
+    def test_defaults_env_file_layering(self, tmp_path, monkeypatch):
+        from ceph_tpu.utils.config import Config
+
+        cfg = Config(env=False)
+        assert cfg.get("osd_pool_default_size") == 3
+        f = tmp_path / "ceph_tpu.conf"
+        f.write_text("osd_pool_default_size = 5\n# comment\n")
+        cfg = Config(conf_file=str(f), env=False)
+        assert cfg.get("osd_pool_default_size") == 5
+        monkeypatch.setenv("CEPH_TPU_OSD_POOL_DEFAULT_SIZE", "7")
+        cfg = Config(conf_file=str(f), env=True)
+        assert cfg.get("osd_pool_default_size") == 7  # env beats file
+
+    def test_validation_and_observers(self):
+        from ceph_tpu.utils.config import Config, ConfigError
+
+        cfg = Config(env=False)
+        with pytest.raises(ConfigError):
+            cfg.set_val("crush_backend", "gpu")
+        with pytest.raises(ConfigError):
+            cfg.set_val("osd_pool_default_size", 0)
+        with pytest.raises(ConfigError):
+            cfg.get("bogus")
+        seen = []
+        cfg.add_observer(lambda k, v: seen.append((k, v)))
+        cfg.set_val("upmap_max_deviation", 3)
+        assert seen == [("upmap_max_deviation", 3)]
+
+
+class TestDout:
+    def test_levels_and_subsys(self):
+        from ceph_tpu.utils import dout
+
+        buf = io.StringIO()
+        dout.set_output(buf)
+        log = dout.subsys_logger("testsub")
+        dout.set_subsys_level("testsub", 5)
+        log(1, "important")
+        log(5, "normal")
+        log(10, "hidden")
+        out = buf.getvalue()
+        assert "important" in out and "normal" in out
+        assert "hidden" not in out
+        assert log.enabled(5) and not log.enabled(6)
