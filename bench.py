@@ -1,17 +1,26 @@
-"""Headline benchmark: batched CRUSH PG→OSD mapping throughput.
+"""Headline benchmarks: CRUSH mapping throughput + EC throughput.
 
-Measures the full 5-stage placement pipeline (ceph_tpu.osd.pipeline_jax) on
-the default jax device (the real TPU chip when present), vs the single-core
-C reference kernel (`crush_do_rule` in a tight loop — the hot loop of
-`crushtool --test`, reference src/crush/CrushTester.cc:612-623) compiled
-from the read-only reference mount.
+Measures, on the default jax device (the real TPU chip when present):
 
-Prints ONE JSON line:
-    {"metric": "pg_mappings_per_sec", "value": N, "unit": "mappings/s",
-     "vs_baseline": N/<single-core C mappings/s>}
+1. PG->OSD mapping rate of the batched 5-stage placement pipeline
+   (ceph_tpu.osd.pipeline_jax) on the BASELINE.md configs:
+     - config 1: 1k PGs / 32 OSDs   (crushtool --test shape)
+     - config 2: 100k PGs / 1k OSDs (osdmaptool --test-map-pgs shape)
+     - headline: BENCH_PGS (default 1M) PGs / BENCH_OSDS (default 1024)
+   vs the single-core C reference kernel (crush_do_rule in a tight loop —
+   the hot loop of crushtool --test, reference src/crush/CrushTester.cc:
+   612-623) compiled from the read-only reference mount.
 
-Env knobs: BENCH_PGS (default 1_000_000), BENCH_OSDS (default 1024),
-BENCH_BASELINE_PGS (default 200_000).
+2. EC throughput (BASELINE.md configs 3-4): RS(k=8,m=4) encode/decode GB/s
+   on the device engine (ec.jax_backend) and the native SIMD engine
+   (reference tool: src/test/erasure-code/ceph_erasure_code_benchmark.cc:
+   156-317), plus Clay(8,4,d=11) single-chunk repair bandwidth.
+
+Prints ONE JSON line; the headline metric stays pg_mappings_per_sec and
+`backend`/`device` record what actually ran (a CPU fallback is explicit,
+never silent).  Env knobs: BENCH_PGS, BENCH_OSDS, BENCH_BASELINE_PGS,
+BENCH_EC_MB, BENCH_REQUIRE_TPU (nonzero = hard-fail if the configured
+accelerator cannot initialize), BENCH_SKIP_EC, BENCH_CHUNK.
 """
 
 from __future__ import annotations
@@ -29,77 +38,202 @@ sys.path.insert(0, str(Path(__file__).resolve().parent / "tests"))
 N_PGS = int(os.environ.get("BENCH_PGS", 1_000_000))
 N_OSDS = int(os.environ.get("BENCH_OSDS", 1024))
 BASELINE_PGS = int(os.environ.get("BENCH_BASELINE_PGS", 200_000))
+EC_MB = int(os.environ.get("BENCH_EC_MB", 16))
 OSD_PER_HOST = 8
+REPS = 3
 
 
-def build_map():
+def init_backend() -> tuple[str, str]:
+    """Initialize jax; return (backend, device_str).  Loud, never silent:
+    a configured-but-unavailable accelerator prints a diagnostic to stderr
+    and (with BENCH_REQUIRE_TPU) aborts instead of quietly benching CPU."""
+    import jax
+
+    configured = os.environ.get("JAX_PLATFORMS", "")
+    try:
+        devs = jax.devices()
+        return jax.default_backend(), str(devs[0])
+    except RuntimeError as e:
+        msg = (
+            f"bench: configured jax platform {configured!r} failed to "
+            f"initialize: {e}"
+        )
+        print(msg, file=sys.stderr)
+        if os.environ.get("BENCH_REQUIRE_TPU", "0") not in ("", "0"):
+            print("bench: BENCH_REQUIRE_TPU set -> aborting", file=sys.stderr)
+            raise SystemExit(2)
+        print("bench: falling back to CPU (recorded in output)",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        return "cpu", str(devs[0])
+
+
+def build_map(n_pgs: int, n_osds: int):
     from ceph_tpu.osd.osdmap import build_hierarchical
     from ceph_tpu.osd.types import PgPool, PoolType
 
-    n_host = max(1, N_OSDS // OSD_PER_HOST)
+    n_host = max(1, n_osds // OSD_PER_HOST)
     pool = PgPool(
         type=PoolType.REPLICATED, size=3, crush_rule=0,
-        pg_num=N_PGS, pgp_num=N_PGS,
+        pg_num=n_pgs, pgp_num=n_pgs,
     )
     return build_hierarchical(
         n_host, OSD_PER_HOST, n_rack=max(1, n_host // 16), pool=pool
     )
 
 
-def bench_tpu(m) -> float:
-    """Mappings/sec of the jitted batched pipeline (steady-state)."""
-    from ceph_tpu.utils import ensure_jax_backend
-
-    ensure_jax_backend()
+def bench_mapping(m, n_pgs: int) -> dict:
+    """Device mapping rate for one map (jitted fast pipeline + rescue)."""
     import jax
     import jax.numpy as jnp
 
     from ceph_tpu.osd.pipeline_jax import PoolMapper
 
     pm = PoolMapper(m, 0, overlays=False)
-    fn = jax.jit(jax.vmap(pm.fn, in_axes=(0, None, 0)))
-    ps = jax.device_put(jnp.arange(N_PGS, dtype=jnp.uint32))
+    fn = jax.jit(jax.vmap(pm._fast, in_axes=(0, None, 0)))
+    ps = jax.device_put(jnp.arange(n_pgs, dtype=jnp.uint32))
     dev = jax.device_put(pm.dev)
-    jax.block_until_ready(fn(ps, dev, {}))  # compile + warm
-    reps = 3
     t0 = time.perf_counter()
-    for _ in range(reps):
+    out = fn(ps, dev, {})
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    unresolved = int(np.asarray(out[-1]).sum())
+    t0 = time.perf_counter()
+    for _ in range(REPS):
         out = fn(ps, dev, {})
     jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / reps
-    return N_PGS / dt
+    dt = (time.perf_counter() - t0) / REPS
+    return {
+        "mappings_per_sec": round(n_pgs / dt, 1),
+        "wall_s": round(dt, 4),
+        "compile_s": round(compile_s, 1),
+        "unresolved": unresolved,
+        "pgs": n_pgs,
+    }
 
 
-def bench_c_reference(m) -> float | None:
+def bench_c_reference(m, n: int) -> float | None:
     """Single-core C crush_do_rule loop; mappings/sec, None if unavailable."""
     try:
         from util_maps import to_oracle
+
+        om = to_oracle(m.crush)
     except Exception:
         return None
-    try:
-        om = to_oracle(m.crush)
-    except (AssertionError, ImportError, OSError):
-        return None
     weights = list(m.osd_weight)
-    n = min(BASELINE_PGS, N_PGS)
-    # warm once, then measure
-    om.bench_rule(0, 0, min(n, 1000), 1, weights, 3)
+    om.bench_rule(0, 0, min(n, 1000), 1, weights, 3)  # warm
     ns, _ = om.bench_rule(0, 0, n, 1, weights, 3)
     if ns <= 0:
         return None
     return n / (ns * 1e-9)
 
 
+def _time_engine(fn, reps=REPS) -> float:
+    fn()  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_ec() -> dict:
+    """RS(8,4) encode/decode + Clay(8,4,11) repair, GB/s of data processed
+    (reference prints seconds/KiB: ceph_erasure_code_benchmark.cc:176-184).
+    """
+    from ceph_tpu.ec.registry import create_erasure_code
+
+    out: dict = {}
+    k, mm = 8, 4
+    L = EC_MB * (1 << 20) // k  # bytes per chunk
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+    total = k * L
+
+    for name, profile in (
+        ("jax", {"plugin": "jax", "k": str(k), "m": str(mm)}),
+        ("native", {"plugin": "isa", "k": str(k), "m": str(mm),
+                    "backend": "native"}),
+    ):
+        try:
+            code = create_erasure_code(dict(profile))
+        except Exception as e:
+            out[f"{name}_error"] = str(e)[:120]
+            continue
+        enc_s = _time_engine(lambda: code.encode_chunks(data))
+        out[f"rs84_encode_gbps_{name}"] = round(total / enc_s / 1e9, 3)
+        encoded = code.encode_chunks(data)
+        chunks = {i: encoded[i] for i in range(k + mm) if i not in (0, 5)}
+        dec_s = _time_engine(
+            lambda: code.decode_chunks({0, 5}, dict(chunks), L)
+        )
+        out[f"rs84_decode2_gbps_{name}"] = round(total / dec_s / 1e9, 3)
+
+    # Clay(8,4,d=11) single-lost-chunk repair: bandwidth advantage is the
+    # point (reads (d+1)/(m+1) of the stripe; ErasureCodeClay.cc:325)
+    try:
+        clay = create_erasure_code(
+            {"plugin": "clay", "k": str(k), "m": str(mm), "d": "11"}
+        )
+        sub = clay.get_sub_chunk_count()
+        Lc = max(4096, (1 << 20) // sub * sub)  # aligned chunk
+        cdata = rng.integers(0, 256, size=(k, Lc), dtype=np.uint8)
+        enc = clay.encode_chunks(cdata)
+        want = {2}
+        need = clay.minimum_to_decode(want, set(range(k + mm)) - want)
+        avail = {i: enc[i] for i in need}
+        rep_s = _time_engine(lambda: clay.decode_chunks(set(want),
+                                                        dict(avail), Lc))
+        out["clay84_repair_gbps"] = round(k * Lc / rep_s / 1e9, 3)
+    except Exception as e:
+        out["clay_error"] = str(e)[:120]
+    return out
+
+
 def main():
-    m = build_map()
-    tpu_rate = bench_tpu(m)
-    c_rate = bench_c_reference(m)
+    backend, device = init_backend()
+
+    headline = build_map(N_PGS, N_OSDS)
+    configs = {}
+
+    # config 1: crushtool --test shape (1k PGs / 32 OSDs)
+    m1 = build_map(1000, 32)
+    configs["crushtool_1k_32"] = bench_mapping(m1, 1000)
+    c1 = bench_c_reference(m1, 100_000)
+    if c1:
+        configs["crushtool_1k_32"]["c_baseline_mps"] = round(c1, 1)
+        configs["crushtool_1k_32"]["vs_c"] = round(
+            configs["crushtool_1k_32"]["mappings_per_sec"] / c1, 3
+        )
+
+    # config 2: osdmaptool --test-map-pgs shape (100k PGs / 1k OSDs)
+    m2 = build_map(100_000, 1024)
+    configs["testmappgs_100k_1k"] = bench_mapping(m2, 100_000)
+    c2 = bench_c_reference(m2, min(BASELINE_PGS, 100_000))
+    if c2:
+        configs["testmappgs_100k_1k"]["c_baseline_mps"] = round(c2, 1)
+        configs["testmappgs_100k_1k"]["vs_c"] = round(
+            configs["testmappgs_100k_1k"]["mappings_per_sec"] / c2, 3
+        )
+
+    # headline: big batch
+    configs["headline"] = bench_mapping(headline, N_PGS)
+    c_rate = bench_c_reference(headline, BASELINE_PGS)
+    tpu_rate = configs["headline"]["mappings_per_sec"]
     vs = tpu_rate / c_rate if c_rate else 0.0
+
+    ec = {} if os.environ.get("BENCH_SKIP_EC") else bench_ec()
+
     print(json.dumps({
         "metric": "pg_mappings_per_sec",
-        "value": round(tpu_rate, 1),
+        "value": tpu_rate,
         "unit": "mappings/s",
         "vs_baseline": round(vs, 2),
+        "backend": backend,
+        "device": device,
+        "c_baseline_mps": round(c_rate, 1) if c_rate else None,
+        "configs": configs,
+        "ec": ec,
     }))
 
 

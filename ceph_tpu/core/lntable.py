@@ -134,6 +134,22 @@ def crush_ln_np(xin) -> np.ndarray:
     return result
 
 
+_LN64K = None
+
+
+def ln64k_table() -> np.ndarray:
+    """Full 2^16-entry crush_ln table: LN64K[u] = crush_ln(u) for the only
+    inputs the mapper ever feeds it (u = hash & 0xffff,
+    reference src/crush/mapper.c:340).  One VMEM-resident gather replaces
+    the normalize + two-table arithmetic per straw2 draw on device."""
+    global _LN64K
+    if _LN64K is None:
+        t = crush_ln_np(np.arange(65536, dtype=np.uint32)).astype(np.int64)
+        t.setflags(write=False)
+        _LN64K = t
+    return _LN64K
+
+
 def crush_ln_jax(xin):
     """Same, for jax arrays inside jit/vmap (uint64 ops; requires x64)."""
     import jax.numpy as jnp

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ceph_tpu.core.lntable import crush_ln_jax
+from ceph_tpu.core.lntable import ln64k_table
 from ceph_tpu.core.rjenkins import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from ceph_tpu.crush.soa import CrushArrays
 from ceph_tpu.crush.types import BucketAlg, ITEM_NONE, RuleOp
@@ -95,7 +95,7 @@ def _straw2_choose(d: _DeviceArrays, slot, x, r, position):
     lane = jnp.arange(A.max_size)
     mask = lane < d.size[slot]
     u = (_h3(x, ids, r) & 0xFFFF).astype(jnp.uint32)
-    ln = crush_ln_jax(u).astype(jnp.int64) - jnp.int64(0x1000000000000)
+    ln = jnp.asarray(ln64k_table())[u] - jnp.int64(0x1000000000000)
     draw = lax.div(ln, jnp.maximum(w, 1))
     draw = jnp.where((w > 0) & mask, draw, S64_MIN)
     return d.items[slot, jnp.argmax(draw)]
@@ -215,8 +215,51 @@ def _is_out(x, item, dev_weights, weight_max):
     return oor | ((w < 0x10000) & ((w == 0) | frac_out))
 
 
+def _walk_bound(A: CrushArrays, start_slots, target_type: int) -> int:
+    """Static upper bound on descent length (bucket choices made) from any
+    of start_slots until an item of target_type (or a device) emerges.
+    The generic bound is the map depth; rules almost always descend from a
+    statically-known level (the TAKE bucket, or buckets of the previous
+    CHOOSE's type), so each traced level of the fori_loop we can prove
+    unreachable is a full straw2 draw saved per candidate per PG."""
+    cap = A.max_depth + 1
+    start_slots = list(start_slots)
+    if not start_slots:
+        return cap
+    memo: dict[int, int] = {}
+
+    def L(slot: int, stack: frozenset) -> int:
+        if slot in stack:
+            return cap  # cyclic map: give up, use the cap
+        if slot in memo:
+            return memo[slot]
+        size = int(A.size[slot])
+        best = 1
+        for it in A.items[slot][:size]:
+            it = int(it)
+            if it >= 0:
+                continue
+            cs = -1 - it
+            if cs >= A.n_buckets:
+                continue
+            if int(A.btype[cs]) == target_type:
+                continue  # walk ends with this choice
+            best = max(best, 1 + L(cs, stack | {slot}))
+            if best >= cap:
+                break
+        memo[slot] = min(best, cap)
+        return memo[slot]
+
+    return min(max(L(s, frozenset()) for s in start_slots), cap)
+
+
+def _slots_of_type(A: CrushArrays, btype: int):
+    return [s for s in range(A.n_buckets) if int(A.btype[s]) == btype]
+
+
 def _descend_impl(
-    d: _DeviceArrays, x, start_item, position, target_type: int, r_of_slot
+    d: _DeviceArrays, x, start_item, position, target_type: int, r_of_slot,
+    bound: int | None = None,
 ):
     """Walk intervening buckets until an item of target_type emerges
     (the retry_bucket descent of reference src/crush/mapper.c:507-555 /
@@ -263,24 +306,26 @@ def _descend_impl(
         )
 
     item, status, r_last = lax.fori_loop(
-        0, A.max_depth + 1, body, (start_item, status0, jnp.int32(0))
+        0, A.max_depth + 1 if bound is None else bound, body,
+        (start_item, status0, jnp.int32(0)),
     )
     # still descending after depth bound => treat as skip (cyclic/deep map)
     status = jnp.where(status == _DESCENDING, jnp.int32(_SKIP), status)
     return item, status, r_last
 
 
-def _descend(d: _DeviceArrays, x, start_item, r, position, target_type: int):
+def _descend(d: _DeviceArrays, x, start_item, r, position, target_type: int,
+             bound: int | None = None):
     """firstn-style descent: one r for the whole walk."""
     item, status, _ = _descend_impl(
-        d, x, start_item, position, target_type, lambda _: r
+        d, x, start_item, position, target_type, lambda _: r, bound
     )
     return item, status
 
 
 def _descend_indep(
     d: _DeviceArrays, x, start_item, rep_base, ftotal, numrep: int,
-    position, target_type: int,
+    position, target_type: int, bound: int | None = None,
 ):
     """indep-style descent: r is re-derived at every level from the current
     bucket — uniform buckets whose size divides numrep use stride numrep+1
@@ -294,7 +339,9 @@ def _descend_indep(
             jnp.int32
         )
 
-    return _descend_impl(d, x, start_item, position, target_type, r_of_slot)
+    return _descend_impl(
+        d, x, start_item, position, target_type, r_of_slot, bound
+    )
 
 
 def _collides(out, outpos, item, lo=0):
@@ -596,13 +643,312 @@ def _choose_indep_one(
     return out, out2, out_size
 
 
-def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
+def _choose_firstn_one_fast(
+    d: _DeviceArrays,
+    x,
+    src,
+    count,
+    dev_weights,
+    *,
+    numrep: int,
+    target_type: int,
+    recurse_to_leaf: bool,
+    tries: int,
+    recurse_tries: int,
+    vary_r: int,
+    stable: int,
+    weight_max: int,
+    out_bound: int,
+    window: int,
+    bound: int | None = None,
+    leaf_bound: int | None = None,
+):
+    """Vectorized crush_choose_firstn (same semantics as
+    _choose_firstn_one; reference src/crush/mapper.c:460-648).
+
+    Key observation: with modern tunables (no local retries) every retry
+    restarts the descent from the TAKE bucket with r = rep + ftotal, so the
+    candidate for a given r depends only on (x, src, r) — not on the retry
+    history.  rep's retry window is the contiguous r-range
+    [rep, rep+tries) and windows of successive reps overlap, so ONE batch
+    of T descents (vmapped over the r axis — no while_loop, no serialized
+    lanes) covers every draw the C could make.  Selection then walks the
+    reps with cheap vectorized mask algebra: first r in the window that
+    descended to a valid candidate, with a cumulative-skip mask
+    reproducing C's skip_rep abort.
+
+    `window` bounds T below the exact numrep+tries-1 (default tries is 50:
+    almost all of those draws are never needed).  A rep whose visible
+    window ends truncated with neither a success nor a skip_rep is
+    *inconclusive*: the returned `unresolved` flag is set and the caller
+    must recompute that x via the loop kernel (PoolMapper/compile_batched
+    do this host-side for the rare flagged lanes — exactness is preserved
+    while the batch pays only for the short window).
+
+    Requires (asserted by the caller choosing this path): choose_args
+    positions == 1 (candidate would otherwise depend on outpos), and
+    chooseleaf_stable=1 for chooseleaf steps (leaf rep is the constant 0,
+    reference src/crush/mapper.c:573-588; stable=0 makes it outpos-
+    dependent — that combination takes the loop path).
+    """
+    NR = out_bound
+    T = min(numrep + tries - 1, window)
+    rs = jnp.arange(T, dtype=jnp.int32)
+    cand, status = jax.vmap(
+        lambda r: _descend(d, x, src, r, 0, target_type, bound)
+    )(rs)
+    found = status == _FOUND
+    skip = status == _SKIP
+
+    leafy = recurse_to_leaf and target_type != 0
+    if not leafy:
+        out_flag = (
+            _is_out(x, cand, dev_weights, weight_max)
+            if target_type == 0 else jnp.zeros(T, bool)
+        )
+
+    lane_nr = jnp.arange(NR)
+    out = jnp.full(NR, ITEM_NONE, jnp.int32)
+    outpos = jnp.int32(0)
+    cnt = jnp.asarray(count, jnp.int32)
+    unresolved = jnp.bool_(False)
+    sel_r = []  # per-rep selected r index (traced scalars)
+    sel_ok = []
+
+    # pass 1 — outer selection.  For chooseleaf the leaf descent is
+    # DEFERRED: we optimistically select each rep's first outer-valid
+    # candidate and verify leaves in pass 2; any leaf failure (which in C
+    # would advance r and re-descend) flags the lane unresolved for the
+    # loop-kernel rescue.  Leaf failures are rare (a whole host's devices
+    # all out/colliding), so this trades T*recurse_tries leaf descents
+    # for numrep + an occasional rescue.
+    for rep in range(numrep):
+        truncated = rep + tries > T  # static
+        in_win = (rs >= rep) & (rs < rep + tries)
+        win_skip = in_win & skip
+        dead_before = (
+            jnp.cumsum(win_skip.astype(jnp.int32))
+            - win_skip.astype(jnp.int32)
+        ) > 0
+        collide = jnp.any(
+            (cand[:, None] == out[None, :]) & (lane_nr[None, :] < outpos),
+            axis=1,
+        )
+        reject = jnp.zeros(T, bool) if leafy else out_flag
+        valid = in_win & found & ~collide & ~reject & ~dead_before
+        ok = jnp.any(valid) & (cnt > 0)
+        if truncated:
+            unresolved = unresolved | (
+                (cnt > 0) & ~ok & ~jnp.any(win_skip)
+            )
+        rstar = jnp.argmax(valid)
+        safe = jnp.clip(outpos, 0, NR - 1)
+        out = out.at[safe].set(jnp.where(ok, cand[rstar], out[safe]))
+        sel_r.append(rstar)
+        sel_ok.append(ok)
+        outpos = outpos + jnp.where(ok, 1, 0)
+        cnt = cnt - jnp.where(ok, 1, 0)
+
+    if not leafy:
+        # out2 mirrors out (devices/buckets chosen directly)
+        return out, out, outpos, unresolved
+
+    # pass 2 — leaf descents for the selected candidates only
+    Rt = recurse_tries
+    sel_rv = jnp.stack(sel_r)  # [numrep]
+    sel_okv = jnp.stack(sel_ok)
+    sel_cand = cand[sel_rv]
+    if vary_r:
+        sub_r = (sel_rv >> (vary_r - 1)).astype(jnp.int32)
+    else:
+        sub_r = jnp.zeros_like(sel_rv)
+    ks = jnp.arange(Rt, dtype=jnp.int32)
+    leaf, lstat = jax.vmap(
+        lambda c, sr: jax.vmap(
+            lambda k: _descend(d, x, c, sr + k, 0, 0, leaf_bound)
+        )(ks)
+    )(sel_cand, sub_r)  # [numrep, Rt]
+    leaf_sel = (lstat == _FOUND) & ~_is_out(x, leaf, dev_weights, weight_max)
+    leaf_skip = lstat == _SKIP
+    # a leaf attempt aborts at the first _SKIP (C returns <= outpos)
+    leaf_dead = (
+        jnp.cumsum(leaf_skip.astype(jnp.int32), axis=1)
+        - leaf_skip.astype(jnp.int32)
+    ) > 0
+    out2 = jnp.full(NR, ITEM_NONE, jnp.int32)
+    pos2 = jnp.int32(0)
+    for rep in range(numrep):
+        ok = sel_okv[rep]
+        lgood = (
+            leaf_sel[rep]
+            & ~leaf_dead[rep]
+            & ~jnp.any(
+                (leaf[rep][:, None] == out2[None, :])
+                & (lane_nr[None, :] < pos2),
+                axis=1,
+            )
+        )
+        lok = jnp.any(lgood)
+        kstar = jnp.argmax(lgood)
+        unresolved = unresolved | (ok & ~lok)
+        place = ok & lok
+        safe = jnp.clip(pos2, 0, NR - 1)
+        out2 = out2.at[safe].set(jnp.where(place, leaf[rep][kstar], out2[safe]))
+        pos2 = pos2 + jnp.where(place, 1, 0)
+    return out, out2, outpos, unresolved
+
+
+def _choose_indep_one_fast(
+    d: _DeviceArrays,
+    x,
+    src,
+    out_size,
+    dev_weights,
+    *,
+    numrep: int,
+    target_type: int,
+    recurse_to_leaf: bool,
+    tries: int,
+    recurse_tries: int,
+    weight_max: int,
+    out_bound: int,
+    bound: int | None = None,
+    leaf_bound: int | None = None,
+):
+    """crush_choose_indep with the per-round rep descents vectorized.
+
+    Same semantics as _choose_indep_one (reference
+    src/crush/mapper.c:655-843); the ftotal round loop stays a while_loop
+    (its trip count is the max retry depth over the batch, typically 1-2),
+    but within a round all NR descents + leaf descents run as one vmapped
+    batch instead of a serialized fori_loop, and the only sequential part
+    left is the cheap duplicate-check fold over the out slots.
+    """
+    NR = out_bound
+    UNDEF = jnp.int32(-0x7FFFFFFE)
+    out = jnp.where(jnp.arange(NR) < out_size, UNDEF, jnp.int32(ITEM_NONE))
+    out2 = out
+    reps = jnp.arange(NR, dtype=jnp.int32)
+    Rt = recurse_tries
+    ks = jnp.arange(Rt, dtype=jnp.int32)
+
+    def round_body(st):
+        ftotal, left, out, out2 = st
+        cand, status, r_last = jax.vmap(
+            lambda rep: _descend_indep(
+                d, x, src, rep, ftotal, numrep, 0, target_type, bound
+            )
+        )(reps)
+        cand_out = _is_out(x, cand, dev_weights, weight_max)
+        if recurse_to_leaf:
+            # leaf retry loop (reference src/crush/mapper.c:784-798)
+            # unrolled over the k axis: first good k before the first skip
+            leaf, lstat, _ = jax.vmap(
+                lambda c, pr, rep: jax.vmap(
+                    lambda k: _descend_indep(
+                        d, x, c, rep + pr, k, numrep, rep, 0, leaf_bound
+                    )
+                )(ks)
+            )(cand, r_last, reps)  # [NR, Rt]
+            lgood = (lstat == _FOUND) & ~_is_out(
+                x, leaf, dev_weights, weight_max
+            )
+            ldead = (
+                jnp.cumsum((lstat == _SKIP).astype(jnp.int32), axis=1)
+                - (lstat == _SKIP).astype(jnp.int32)
+            ) > 0
+            lsel = lgood & ~ldead
+            leaf_ok_v = jnp.any(lsel, axis=1)
+            kstar = jnp.argmax(lsel, axis=1)
+            leaf_v = jnp.take_along_axis(leaf, kstar[:, None], axis=1)[:, 0]
+
+        def rep_step(rep, st2):
+            out, out2, left = st2
+            todo = (rep < out_size) & (out[rep] == UNDEF)
+            c = cand[rep]
+            found_nc = (status[rep] == _FOUND) & ~jnp.any(
+                jnp.where(jnp.arange(NR) < out_size, out, ITEM_NONE) == c
+            )
+            dev = c >= 0
+            if recurse_to_leaf:
+                lok = leaf_ok_v[rep]
+                leaf_val = jnp.where(
+                    dev, c, jnp.where(lok, leaf_v[rep], jnp.int32(ITEM_NONE))
+                )
+                leaf_fail = ~(lok | dev)
+            else:
+                leaf_fail = jnp.bool_(False)
+            if target_type == 0:
+                reject = cand_out[rep]
+            else:
+                reject = jnp.bool_(False)
+            hard = status[rep] == _SKIP
+            good = found_nc & ~leaf_fail & ~reject
+            newv = jnp.where(
+                hard, jnp.int32(ITEM_NONE), jnp.where(good, c, UNDEF)
+            )
+            if recurse_to_leaf:
+                newl = jnp.where(
+                    hard,
+                    jnp.int32(ITEM_NONE),
+                    jnp.where(found_nc, leaf_val, out2[rep]),
+                )
+            else:
+                newl = newv
+            out = out.at[rep].set(jnp.where(todo, newv, out[rep]))
+            out2 = out2.at[rep].set(jnp.where(todo, newl, out2[rep]))
+            left = left - jnp.where(todo & (hard | good), 1, 0)
+            return out, out2, left
+
+        for rep in range(NR):
+            out, out2, left = rep_step(rep, (out, out2, left))
+        return ftotal + 1, left, out, out2
+
+    def round_cond(st):
+        ftotal, left, out, out2 = st
+        return (left > 0) & (ftotal < tries)
+
+    _, _, out, out2 = lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), jnp.int32(out_size), out, out2)
+    )
+    out = jnp.where(out == UNDEF, ITEM_NONE, out)
+    out2 = jnp.where(out2 == UNDEF, ITEM_NONE, out2)
+    return out, out2, out_size, jnp.bool_(False)
+
+
+FAST_WINDOW_EXTRA = 8  # default r-window slack beyond numrep (see above)
+
+
+def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
+                 path: str = "auto", window_extra: int = FAST_WINDOW_EXTRA,
+                 with_flag: bool = False):
     """Build the single-x mapping function for one rule; vmap/jit-ready.
 
     Returns fn(x: u32 scalar, dev_weights: u32[max_devices]) -> i32[result_max]
     mirroring crush_do_rule's result vector (padded with ITEM_NONE; the C
     returns a length instead — callers mask on ITEM_NONE).
+
+    path: "auto" picks the vectorized candidate-batch kernel where its
+    preconditions hold (the common modern-tunables case) and the bounded
+    masked-loop kernel otherwise; "fast"/"loop" force one (fast asserts
+    its preconditions).
+
+    with_flag: fn additionally returns an `unresolved` bool — True when
+    the fast kernel's bounded candidate window (numrep + window_extra
+    draws) was exhausted inconclusively and the caller must recompute
+    this x via the loop kernel to stay bit-exact (see
+    _choose_firstn_one_fast; always False on the loop path).
+
+    Without with_flag there is no way to honor that contract, so
+    path="auto" then resolves to the (always-exact) loop kernel;
+    requesting the fast kernel flagless is an error.
     """
+    if path == "auto" and not with_flag:
+        path = "loop"
+    assert not (path == "fast" and not with_flag), (
+        "fast kernel's bounded window is inexact without the unresolved "
+        "flag + caller rescue; pass with_flag=True (or use compile_batched)"
+    )
     t = A.tunables
     assert t.choose_local_tries == 0 and t.choose_local_fallback_tries == 0, (
         "legacy local-retry tunables unsupported in the TPU kernel; "
@@ -650,6 +996,9 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
         wbound = 0  # static upper bound on wsize
         result = jnp.full(RMAX, ITEM_NONE, jnp.int32)
         rlen = jnp.int32(0)
+        unresolved = jnp.bool_(False)
+
+        src_slots: list[int] = []  # statically-known source bucket slots
 
         for (op, arg1, arg2, s_tries, s_leaf_tries, s_vary_r,
              s_stable) in steps:
@@ -661,6 +1010,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
                     w_items = w_items.at[0].set(arg1)
                     wsize = jnp.int32(1)
                     wbound = 1
+                    src_slots = [-1 - arg1] if arg1 < 0 else []
             elif op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN,
                         RuleOp.CHOOSE_INDEP, RuleOp.CHOOSELEAF_INDEP):
                 numrep = arg1 if arg1 > 0 else RMAX + arg1
@@ -679,6 +1029,29 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
                 else:
                     recurse_tries = s_leaf_tries if s_leaf_tries else 1
 
+                # fast-path eligibility (see _choose_firstn_one_fast)
+                fast_ok_firstn = (
+                    A.positions == 1
+                    and (not leafy or arg2 == 0 or s_stable)
+                    and recurse_tries <= 8
+                )
+                fast_ok_indep = recurse_tries <= 8
+                if path == "fast":
+                    assert fast_ok_firstn if firstn else fast_ok_indep, (
+                        "fast mapper path preconditions unmet for this "
+                        "rule/map (choose_args positions>1, stable=0 "
+                        "chooseleaf, or large chooseleaf tries)"
+                    )
+                use_fast = path != "loop" and (
+                    fast_ok_firstn if firstn else fast_ok_indep
+                )
+                # static descent-length bounds for this step
+                bound = _walk_bound(A, src_slots, arg2)
+                leaf_bound = (
+                    _walk_bound(A, _slots_of_type(A, arg2), 0)
+                    if leafy and arg2 != 0 else None
+                )
+
                 o = jnp.full(RMAX, ITEM_NONE, jnp.int32)
                 osize = jnp.int32(0)
                 for i in range(min(wbound, RMAX)):
@@ -688,27 +1061,50 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
                         count = jnp.where(
                             src_ok, RMAX - osize, 0
                         )
-                        vals, leafs, n = _choose_firstn_one(
-                            d, x, src, count, dev_weights,
-                            numrep=numrep, target_type=arg2,
-                            recurse_to_leaf=leafy, tries=s_tries,
-                            recurse_tries=recurse_tries,
-                            vary_r=s_vary_r, stable=s_stable,
-                            weight_max=weight_max, out_bound=NR,
-                        )
+                        if use_fast:
+                            vals, leafs, n, flg = _choose_firstn_one_fast(
+                                d, x, src, count, dev_weights,
+                                numrep=numrep, target_type=arg2,
+                                recurse_to_leaf=leafy, tries=s_tries,
+                                recurse_tries=recurse_tries,
+                                vary_r=s_vary_r, stable=s_stable,
+                                weight_max=weight_max, out_bound=NR,
+                                window=numrep + window_extra,
+                                bound=bound, leaf_bound=leaf_bound,
+                            )
+                            unresolved = unresolved | flg
+                        else:
+                            vals, leafs, n = _choose_firstn_one(
+                                d, x, src, count, dev_weights,
+                                numrep=numrep, target_type=arg2,
+                                recurse_to_leaf=leafy, tries=s_tries,
+                                recurse_tries=recurse_tries,
+                                vary_r=s_vary_r, stable=s_stable,
+                                weight_max=weight_max, out_bound=NR,
+                            )
                     else:
                         out_size = jnp.where(
                             src_ok,
                             jnp.minimum(NR, RMAX - osize),
                             0,
                         )
-                        vals, leafs, n = _choose_indep_one(
-                            d, x, src, out_size, dev_weights,
-                            numrep=numrep, target_type=arg2,
-                            recurse_to_leaf=leafy, tries=s_tries,
-                            recurse_tries=recurse_tries,
-                            weight_max=weight_max, out_bound=NR,
-                        )
+                        if use_fast:
+                            vals, leafs, n, _ = _choose_indep_one_fast(
+                                d, x, src, out_size, dev_weights,
+                                numrep=numrep, target_type=arg2,
+                                recurse_to_leaf=leafy, tries=s_tries,
+                                recurse_tries=recurse_tries,
+                                weight_max=weight_max, out_bound=NR,
+                                bound=bound, leaf_bound=leaf_bound,
+                            )
+                        else:
+                            vals, leafs, n = _choose_indep_one(
+                                d, x, src, out_size, dev_weights,
+                                numrep=numrep, target_type=arg2,
+                                recurse_to_leaf=leafy, tries=s_tries,
+                                recurse_tries=recurse_tries,
+                                weight_max=weight_max, out_bound=NR,
+                            )
                     emit_vals = leafs if leafy else vals
                     # scatter emit_vals[:n] into o at osize
                     idx = osize + jnp.arange(NR)
@@ -721,6 +1117,12 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
                 w_items = o
                 wsize = jnp.minimum(osize, RMAX)
                 wbound = min(wbound * NR, RMAX)
+                # next step's sources: buckets of this step's target type
+                # (chooseleaf emits devices: no statically-known slots)
+                src_slots = (
+                    _slots_of_type(A, arg2) if not leafy and arg2 != 0
+                    else []
+                )
             elif op == RuleOp.EMIT:
                 idx = rlen + jnp.arange(RMAX)
                 keep = (jnp.arange(RMAX) < wsize) & (idx < RMAX)
@@ -731,12 +1133,62 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int):
                 w_items = jnp.full(RMAX, ITEM_NONE, jnp.int32)
                 wsize = jnp.int32(0)
                 wbound = 0
+        if with_flag:
+            return result, unresolved
         return result
 
     return fn
 
 
-def compile_batched(A: CrushArrays, ruleno: int, result_max: int):
-    """jit(vmap(...)): fn(xs: u32[N], dev_weights: u32[D]) -> i32[N, RMAX]."""
-    fn = compile_rule(A, ruleno, result_max)
-    return jax.jit(jax.vmap(fn, in_axes=(0, None)))
+RESCUE_PAD = 1024  # fixed loop-kernel batch size for flagged lanes
+
+
+def compile_batched(A: CrushArrays, ruleno: int, result_max: int,
+                    path: str = "auto", chunk: int | None = None,
+                    window_extra: int = FAST_WINDOW_EXTRA):
+    """Batched mapper: fn(xs: u32[N], dev_weights: u32[D]) -> i32[N, RMAX].
+
+    Host-level callable (not itself jittable): runs the jitted fast
+    kernel over the batch, then — exactness rescue — recomputes the rare
+    lanes whose bounded candidate window was inconclusive through the
+    jitted loop kernel in fixed-size RESCUE_PAD blocks.
+
+    chunk: if set, evaluate the batch in fixed-size chunks via lax.map
+    (bounds peak memory for the [N, T, S] candidate intermediates of the
+    fast path; N must be a multiple of chunk).
+    """
+    fast = compile_rule(A, ruleno, result_max, path=path,
+                        window_extra=window_extra, with_flag=True)
+    vfast = jax.vmap(fast, in_axes=(0, None))
+    if chunk is None:
+        jfast = jax.jit(vfast)
+    else:
+        @jax.jit
+        def jfast(xs, dev_weights):
+            n = xs.shape[0]
+            assert n % chunk == 0, (n, chunk)
+            blocks = xs.reshape(n // chunk, chunk)
+            res, flg = lax.map(lambda b: vfast(b, dev_weights), blocks)
+            return res.reshape(n, -1), flg.reshape(n)
+
+    jloop_cell = []
+
+    def run(xs, dev_weights):
+        res, flg = jfast(jnp.asarray(xs), jnp.asarray(dev_weights))
+        flg = np.asarray(flg)
+        if not flg.any():
+            return np.asarray(res)  # same (numpy) type on both paths
+        if not jloop_cell:
+            loop = compile_rule(A, ruleno, result_max, path="loop")
+            jloop_cell.append(jax.jit(jax.vmap(loop, in_axes=(0, None))))
+        jloop = jloop_cell[0]
+        res = np.array(res)  # writable copy
+        xs = np.asarray(xs)
+        idx = np.nonzero(flg)[0]
+        for i in range(0, len(idx), RESCUE_PAD):
+            blk = idx[i:i + RESCUE_PAD]
+            pad = np.resize(blk, RESCUE_PAD)  # cycle-pad to fixed size
+            res[blk] = np.asarray(jloop(xs[pad], dev_weights))[:len(blk)]
+        return res
+
+    return run

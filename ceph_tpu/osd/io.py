@@ -95,14 +95,28 @@ def osdmap_from_dict(d: dict) -> OSDMap:
     return m
 
 
-def save_osdmap(m: OSDMap, path: str) -> None:
+def save_osdmap(m: OSDMap, path: str, fmt: str = "bin") -> None:
+    """fmt="bin" writes the reference wire format (what the real
+    osdmaptool produces/consumes); fmt="json" writes the native JSON."""
+    if fmt == "bin":
+        from ceph_tpu.osd.codec import encode_osdmap
+
+        with open(path, "wb") as f:
+            f.write(encode_osdmap(m))
+        return
     with open(path, "w") as f:
         json.dump(osdmap_to_dict(m), f, indent=1)
 
 
 def load_osdmap(path: str) -> OSDMap:
-    with open(path) as f:
-        return osdmap_from_dict(json.load(f))
+    """Auto-detects the reference binary wire format vs native JSON."""
+    from ceph_tpu.osd.codec import decode_osdmap, looks_like_osdmap
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if looks_like_osdmap(data):
+        return decode_osdmap(data)
+    return osdmap_from_dict(json.loads(data.decode()))
 
 
 def save_crush_text(m: CrushMap, path: str) -> None:

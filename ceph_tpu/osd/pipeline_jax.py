@@ -33,7 +33,7 @@ import numpy as np
 from ceph_tpu.core.intmath import pg_mask_for, stable_mod
 from ceph_tpu.core.rjenkins import crush_hash32_2
 from ceph_tpu.crush import mapper_ref
-from ceph_tpu.crush.mapper_jax import compile_rule
+from ceph_tpu.crush.mapper_jax import RESCUE_PAD, compile_rule
 from ceph_tpu.crush.soa import CrushArrays, build_arrays
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd.osdmap import (
@@ -205,6 +205,8 @@ def compile_pipeline(
     with_temp: bool = False,
     with_primary_temp: bool = False,
     with_primary_affinity: bool = True,
+    path: str = "auto",
+    with_flag: bool = False,
 ):
     """Build the single-PG mapping function for one pool; vmap/jit-ready.
 
@@ -212,10 +214,18 @@ def compile_pipeline(
     where `dev` is the padded dict built by PoolMapper (exists/up bool[DV],
     weight/primary_affinity u32[DV], DV = max(crush devices, max_osd)) and
     `ov` holds this PG's overlay rows (only statically-enabled ones read).
+
+    path / with_flag: forwarded to the CRUSH kernel (see
+    ceph_tpu.crush.mapper_jax.compile_rule).  With with_flag the tuple
+    grows a trailing `unresolved` bool; PoolMapper.map_batch uses it to
+    recompute flagged PGs through the loop kernel (bit-exactness rescue).
     """
     W = spec.out_width
     R = spec.size
-    rule_fn = compile_rule(A, spec.ruleno, R) if spec.ruleno >= 0 else None
+    rule_fn = (
+        compile_rule(A, spec.ruleno, R, path=path, with_flag=with_flag)
+        if spec.ruleno >= 0 else None
+    )
     D = A.max_devices  # crush device-id bound (weight vec for the kernel)
     MO = spec.max_osd  # OSDMap id bound (exists / upmap targets)
     DV = max(D, MO, 1)
@@ -240,8 +250,12 @@ def compile_pipeline(
             pps = (ps2 + jnp.uint32(spec.pool_id)).astype(jnp.uint32)
 
         # -- stage 2: CRUSH (reference src/osd/OSDMap.cc:2444-2447) --------
+        unresolved = jnp.bool_(False)
         if rule_fn is None:
             raw = jnp.full(W, ITEM_NONE, jnp.int32)
+        elif with_flag:
+            raw, unresolved = rule_fn(pps, weight[:D])
+            raw = _pad_lanes(raw, W)
         else:
             raw = _pad_lanes(rule_fn(pps, weight[:D]), W)
 
@@ -347,6 +361,8 @@ def compile_pipeline(
                 )
             else:
                 acting_primary = jnp.where(pt >= 0, pt, up_primary)
+        if with_flag:
+            return up, up_primary, acting, acting_primary, unresolved
         return up, up_primary, acting, acting_primary
 
     return fn
@@ -360,7 +376,8 @@ class PoolMapper:
         up, up_primary, acting, acting_primary = pm.map_all()
     """
 
-    def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True):
+    def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True,
+                 path: str = "auto"):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
@@ -372,14 +389,19 @@ class PoolMapper:
         self.spec = PoolSpec.for_pool(
             m, pool_id, extra_width=self.ov.extra_width
         )
-        self.fn = compile_pipeline(
-            self.arrays,
-            self.spec,
+        self._pipe_kw = dict(
             with_upmap_full=self.ov.upmap_full is not None,
             n_upmap_pairs=self.ov.n_pairs,
             with_temp=self.ov.temp is not None,
             with_primary_temp=self.ov.primary_temp is not None,
             with_primary_affinity=m.osd_primary_affinity is not None,
+        )
+        self.fn = compile_pipeline(
+            self.arrays, self.spec, path=path, **self._pipe_kw
+        )
+        self._fast = compile_pipeline(
+            self.arrays, self.spec, path=path, with_flag=True,
+            **self._pipe_kw,
         )
         dv = m.frozen_vectors()
         DV = max(self.arrays.max_devices, m.max_osd, 1)
@@ -392,6 +414,7 @@ class PoolMapper:
             ),
         }
         self._jitted = None
+        self._jloop = None
 
     def _ov_rows(self, ps: np.ndarray) -> dict:
         ov, rows = self.ov, {}
@@ -409,13 +432,37 @@ class PoolMapper:
 
     def map_batch(self, ps: np.ndarray):
         """Map a batch of placement seeds.  Returns numpy
-        (up[N,W], up_primary[N], acting[N,W], acting_primary[N])."""
+        (up[N,W], up_primary[N], acting[N,W], acting_primary[N]).
+
+        Runs the fast-window kernel; PGs whose candidate window was
+        inconclusive (rare) are recomputed exactly through the loop
+        kernel in fixed-size blocks (see mapper_jax.compile_batched)."""
         if self._jitted is None:
-            self._jitted = jax.jit(jax.vmap(self.fn, in_axes=(0, None, 0)))
+            self._jitted = jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0)))
         ps = np.asarray(ps)
-        out = self._jitted(
+        *out, flg = self._jitted(
             jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
         )
+        flg = np.asarray(flg)
+        if flg.any():
+            if self._jloop is None:
+                loop_fn = compile_pipeline(
+                    self.arrays, self.spec, path="loop", **self._pipe_kw
+                )
+                self._jloop = jax.jit(jax.vmap(loop_fn, in_axes=(0, None, 0)))
+            out = [np.array(o) for o in out]  # writable copies
+            idx = np.nonzero(flg)[0]
+            P = RESCUE_PAD
+            for i in range(0, len(idx), P):
+                blk = idx[i:i + P]
+                pad = np.resize(blk, P)  # cycle-pad: one compile per shape
+                sub = self._jloop(
+                    jnp.asarray(ps[pad], np.uint32), self.dev,
+                    self._ov_rows(ps[pad]),
+                )
+                for o, s in zip(out, sub):
+                    o[blk] = np.asarray(s)[: len(blk)]
+            return tuple(out)
         return tuple(np.asarray(o) for o in out)
 
     def map_all(self):
