@@ -368,6 +368,11 @@ def compile_pipeline(
     return fn
 
 
+DEFAULT_CHUNK = 65536  # PG-axis block size: peak device memory for the
+                       # fast kernel's [B, T, lanes] intermediates is
+                       # O(chunk), never O(pg_num)
+
+
 class PoolMapper:
     """Compiled batched mapper for one pool of one OSDMap.
 
@@ -377,7 +382,7 @@ class PoolMapper:
     """
 
     def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True,
-                 path: str = "auto"):
+                 path: str = "auto", chunk: int | None = DEFAULT_CHUNK):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
@@ -415,6 +420,7 @@ class PoolMapper:
         }
         self._jitted = None
         self._jloop = None
+        self.chunk = chunk
 
     def _ov_rows(self, ps: np.ndarray) -> dict:
         ov, rows = self.ov, {}
@@ -434,12 +440,28 @@ class PoolMapper:
         """Map a batch of placement seeds.  Returns numpy
         (up[N,W], up_primary[N], acting[N,W], acting_primary[N]).
 
-        Runs the fast-window kernel; PGs whose candidate window was
-        inconclusive (rare) are recomputed exactly through the loop
-        kernel in fixed-size blocks (see mapper_jax.compile_batched)."""
+        Batches larger than self.chunk run block-by-block (blocks
+        cycle-padded to one fixed shape: one compile, O(chunk) peak
+        device memory).  Within a block the fast-window kernel runs
+        first; PGs whose candidate window was inconclusive (rare) are
+        recomputed exactly through the loop kernel in fixed-size blocks
+        (see mapper_jax.compile_batched)."""
+        ps = np.asarray(ps)
+        if self.chunk and len(ps) > self.chunk:
+            B = self.chunk
+            parts = []
+            for i in range(0, len(ps), B):
+                blk = ps[i:i + B]
+                sub = self._map_block(np.resize(blk, B))
+                parts.append(tuple(o[: len(blk)] for o in sub))
+            return tuple(
+                np.concatenate([p[j] for p in parts]) for j in range(4)
+            )
+        return self._map_block(ps)
+
+    def _map_block(self, ps: np.ndarray):
         if self._jitted is None:
             self._jitted = jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0)))
-        ps = np.asarray(ps)
         *out, flg = self._jitted(
             jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
         )
