@@ -15,6 +15,8 @@ src/tools/osdmaptool.cc:41-68 usage):
                         [--upmap-max N] [--upmap-pool name]
     osdmaptool mapfile --upmap-cleanup
     osdmaptool mapfile --export-crush f / --import-crush f
+    osdmaptool mapfile --apply-incremental incfile   (repeatable; applies
+                        binary OSDMap::Incremental epoch deltas in order)
 
 Map files are the framework's JSON osdmap format (ceph_tpu.osd.io); the
 stats output mirrors the reference's --test-map-pgs table
@@ -198,6 +200,7 @@ def main(argv: list[str] | None = None) -> int:
     export_crush = None
     import_crush = None
     test_map_pg = None
+    incrementals: list[str] = []
 
     i = 0
 
@@ -250,6 +253,8 @@ def main(argv: list[str] | None = None) -> int:
             export_crush = next_arg(a)
         elif a == "--import-crush":
             import_crush = next_arg(a)
+        elif a == "--apply-incremental":
+            incrementals.append(next_arg(a))
         elif mapfile is None and not a.startswith("-"):
             mapfile = a
         else:
@@ -280,6 +285,22 @@ def main(argv: list[str] | None = None) -> int:
 
     m = load_osdmap(mapfile)
     dirty = False
+
+    for incfile in incrementals:
+        from ceph_tpu.osd.incremental import (
+            apply_incremental,
+            decode_incremental,
+        )
+
+        with open(incfile, "rb") as f:
+            inc = decode_incremental(f.read())
+        m = apply_incremental(m, inc)
+        print(
+            f"osdmaptool: applied incremental epoch {inc.epoch} from "
+            f"{incfile}",
+            file=sys.stderr,
+        )
+        dirty = True
 
     if import_crush:
         m.crush = load_crush_text(import_crush)

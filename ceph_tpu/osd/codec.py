@@ -443,6 +443,7 @@ def decode_osdmap(data: bytes) -> OSDMap:
             k = r.string()
             profs[name][k] = r.string()
     wire["erasure_code_profiles"] = profs
+    m.erasure_code_profiles = profs
     if v >= 4:
         n = r.u32()
         for _ in range(n):
@@ -604,7 +605,7 @@ def encode_osdmap(m: OSDMap) -> bytes:
         cblob = encode_crushmap(m.crush)
     w.u32(len(cblob))
     w.raw(cblob)
-    profs = wire.get("erasure_code_profiles", {})
+    profs = m.erasure_code_profiles
     w.u32(len(profs))
     for name in sorted(profs):
         w.string(name)

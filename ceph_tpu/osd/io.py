@@ -47,6 +47,7 @@ def osdmap_to_dict(m: OSDMap) -> dict:
             }
             for pid, p in m.pools.items()
         },
+        "erasure_code_profiles": m.erasure_code_profiles,
         "pg_temp": {str(pg): v for pg, v in m.pg_temp.items()},
         "primary_temp": {str(pg): v for pg, v in m.primary_temp.items()},
         "pg_upmap": {str(pg): v for pg, v in m.pg_upmap.items()},
@@ -79,6 +80,9 @@ def osdmap_from_dict(d: dict) -> OSDMap:
             erasure_code_profile=pd.get("erasure_code_profile", ""),
         )
         m.add_pool(pd.get("name", f"pool{pid_s}"), pool, int(pid_s))
+    m.erasure_code_profiles = {
+        k: dict(v) for k, v in d.get("erasure_code_profiles", {}).items()
+    }
     m.pg_temp = {
         PgId.parse(k): list(v) for k, v in d.get("pg_temp", {}).items()
     }

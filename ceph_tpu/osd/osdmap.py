@@ -56,6 +56,8 @@ class OSDMap:
         self.primary_temp: dict[PgId, int] = {}
         self.pg_upmap: dict[PgId, list[int]] = {}
         self.pg_upmap_items: dict[PgId, list[tuple[int, int]]] = {}
+        # EC profile registry (reference src/osd/OSDMap.h:598)
+        self.erasure_code_profiles: dict[str, dict[str, str]] = {}
 
     # -- OSD state ---------------------------------------------------------
     def set_max_osd(self, n: int) -> None:
