@@ -117,7 +117,14 @@ class Reader:
 def encode_crushmap(m: CrushMap) -> bytes:
     w = Writer()
     w.u32(CRUSH_MAGIC)
-    max_buckets = m.max_buckets
+    max_buckets = getattr(m, "wire_max_buckets", None)
+    if max_buckets is None or max_buckets < m.max_buckets:
+        # emulate the C builder's slot-array growth (8, 16, 32, ...;
+        # builder.c crush_add_bucket) so built maps encode byte-identical
+        # to maps built through the reference builder
+        max_buckets = 0 if not m.buckets else 8
+        while max_buckets < m.max_buckets:
+            max_buckets *= 2
     n_rules = len(m.rules)
     w.i32(max_buckets)
     w.u32(n_rules)
@@ -255,6 +262,10 @@ def decode_crushmap(data: bytes) -> CrushMap:
     m = CrushMap(Tunables.profile("legacy"))
     m.type_names = {}
     m.max_devices = max_devices
+    # preserve the stored slot-array size: the C builder's capacity grows
+    # 8,16,32,... and empty slots encode as a 4-byte 0 (builder.c
+    # crush_add_bucket), so re-encode must replay the same capacity
+    m.wire_max_buckets = max_buckets
 
     for i in range(max_buckets):
         alg = r.u32()

@@ -422,7 +422,14 @@ def decompile(m: CrushMap) -> str:
 
     out.append("\n# devices\n")
     for did in range(m.max_devices):
-        name = m.item_names.get(did, f"osd.{did}")
+        # unnamed device slots are holes: no line (reference
+        # CrushCompiler.cc decompile device loop)
+        name = m.item_names.get(did)
+        if name is None:
+            if any(did in b.items for b in m.buckets.values()):
+                name = f"osd.{did}"  # in-tree but unnamed
+            else:
+                continue
         line = f"device {did} {name}"
         if did in m.item_classes:
             line += f" class {m.item_classes[did]}"

@@ -420,6 +420,46 @@ class CrushMap:
         return item, -1
 
     # -- convenience -------------------------------------------------------
+    def insert_item(
+        self, item: int, weightf: float, name: str,
+        loc: dict[str, str],
+    ) -> None:
+        """CrushWrapper::insert_item semantics (reference
+        src/crush/CrushWrapper.cc:1095-1210): walk the type hierarchy
+        bottom-up creating missing location buckets (straw2, weight 0)
+        and splice the chain into the first existing ancestor; then set
+        the item's weight and bubble the delta to the roots.  Bucket ids
+        are allocated lowest-free-slot (-1-slot), matching the C
+        builder, so maps built this way decompile identically."""
+        if self.item_names.get(item, name) != name and item >= 0:
+            raise ValueError(f"name {name!r} vs existing "
+                             f"{self.item_names[item]!r}")
+        self.item_names.setdefault(item, name)
+        name_to_id = {n: i for i, n in self.item_names.items()}
+        cur = item
+        for type_id in sorted(self.type_names):
+            if type_id == 0:
+                continue
+            tname = self.type_names[type_id]
+            if tname not in loc:
+                continue
+            bname = loc[tname]
+            if bname not in name_to_id:
+                bid = self.add_bucket(
+                    BucketAlg.STRAW2, type_id, [cur], [0], name=bname
+                )
+                name_to_id[bname] = bid
+                cur = bid
+                continue
+            b = self.buckets[name_to_id[bname]]
+            b.items.append(cur)
+            b.weights.append(0)
+            b.finalize_derived(self.tunables.straw_calc_version)
+            break
+        if item >= 0:
+            self.max_devices = max(self.max_devices, item + 1)
+        self.adjust_item_weight(item, int(round(weightf * 0x10000)))
+
     def make_replicated_rule(
         self, root: int, failure_domain_type: int, num_rep: int = 0
     ) -> int:

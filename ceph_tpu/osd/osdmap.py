@@ -389,6 +389,7 @@ def build_simple(
     default_pool: bool = True,
     chooseleaf_type: int = 1,
     tunables: Tunables | None = None,
+    mark_up_in: bool = True,
 ) -> OSDMap:
     """OSDMap::build_simple semantics (reference src/osd/OSDMap.cc:4172-4270 +
     build_simple_crush_map :4307-4337): all OSDs at weight 1.0 under
@@ -397,30 +398,28 @@ def build_simple(
     poolbase<<pg_bits PGs."""
     crush = CrushMap(tunables)
     crush.type_names = dict(DEFAULT_TYPES)
-    osds = list(range(n_osd))
-    host = crush.add_bucket(
-        BucketAlg.STRAW2, 1, osds, [IN_WEIGHT] * n_osd, name="localhost"
-    )
-    rack = crush.add_bucket(
-        BucketAlg.STRAW2, 3, [host], [IN_WEIGHT * n_osd], name="localrack"
-    )
-    root = crush.add_bucket(
-        BucketAlg.STRAW2, 11, [rack], [IN_WEIGHT * n_osd], name="default"
-    )
-    for o in osds:
-        crush.item_names[o] = f"osd.{o}"
+    # bucket id order matches the reference builder: root -1 first, then
+    # insert_item creates host -2 / rack -3 on the first device's walk
+    root = crush.add_bucket(BucketAlg.STRAW2, 11, [], [], name="default")
+    loc = {"host": "localhost", "rack": "localrack", "root": "default"}
+    for o in range(n_osd):
+        crush.insert_item(o, 1.0, f"osd.{o}", loc)
     crush.make_replicated_rule(root, chooseleaf_type)
+    crush.rule_names[0] = "replicated_rule"
 
     m = OSDMap(crush)
     m.set_max_osd(n_osd)
-    for o in osds:
-        m.mark_up_in(o)
+    if mark_up_in:
+        for o in range(n_osd):
+            m.mark_up_in(o)
     if default_pool and n_osd:
+        # pool id 1, as the reference's ++pool_max from 0 produces
         pool = PgPool(
             type=PoolType.REPLICATED, size=3, crush_rule=0,
             pg_num=n_osd << pg_bits, pgp_num=n_osd << min(pgp_bits, pg_bits),
         )
-        m.add_pool("rbd", pool)
+        m.pool_max = 0
+        m.add_pool("rbd", pool, 1)
     return m
 
 

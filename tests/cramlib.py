@@ -1,0 +1,153 @@
+"""Minimal cram-format transcript runner.
+
+Executes the reference's .t CLI transcripts (reference
+src/test/cli/{osdmaptool,crushtool}/*.t) against OUR tools: a shim dir
+maps `osdmaptool`/`crushtool` onto python -m ceph_tpu.cli.*, each `  $ `
+command runs through bash in a scratch dir with TESTDIR set, and output
+is matched with cram's rules:
+
+- plain lines: byte-exact (including trailing whitespace)
+- `line (re)`: regex, anchored both ends
+- `line (esc)`: python-style escapes (\\t etc) decoded first
+- `line (glob)`: * and ? wildcards
+- `[N]`: expected exit status (absent = 0)
+
+Returns per-command diffs so a failing transcript pinpoints the first
+divergence.
+"""
+
+from __future__ import annotations
+
+import codecs
+import fnmatch
+import os
+import re
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@dataclass
+class Command:
+    line_no: int
+    cmd: str
+    expected: list[str] = field(default_factory=list)
+    exit_code: int = 0
+
+
+def parse_t(path: Path) -> list[Command]:
+    cmds: list[Command] = []
+    cur: Command | None = None
+    for i, raw in enumerate(path.read_text().splitlines(), 1):
+        if raw.startswith("  $ "):
+            cur = Command(i, raw[4:])
+            cmds.append(cur)
+        elif raw.startswith("  > ") and cur is not None:
+            cur.cmd += "\n" + raw[4:]
+        elif raw.startswith("  ") and cur is not None:
+            line = raw[2:]
+            m = re.fullmatch(r"\[(\d+)\]", line)
+            if m:
+                cur.exit_code = int(m.group(1))
+            else:
+                cur.expected.append(line)
+        # comment / blank lines reset nothing
+    return cmds
+
+
+def _match_line(expected: str, actual: str) -> bool:
+    if expected.endswith(" (esc)"):
+        want = codecs.decode(expected[:-6], "unicode_escape")
+        return want == actual
+    if expected.endswith(" (re)"):
+        try:
+            return re.fullmatch(expected[:-5], actual) is not None
+        except re.error:
+            return False
+    if expected.endswith(" (glob)"):
+        return fnmatch.fnmatchcase(actual, expected[:-7])
+    return expected == actual
+
+
+def make_shims(shim_dir: Path) -> None:
+    shim_dir.mkdir(parents=True, exist_ok=True)
+    for tool in ("osdmaptool", "crushtool"):
+        sh = shim_dir / tool
+        sh.write_text(
+            "#!/bin/sh\n"
+            f'PYTHONPATH="{REPO}" JAX_PLATFORMS=cpu '
+            "TF_CPP_MIN_LOG_LEVEL=3 "  # silence XLA slow-op alarms
+            f'exec python3 -u -m ceph_tpu.cli.{tool} "$@"\n'
+        )
+        sh.chmod(0o755)
+
+
+@dataclass
+class CmdResult:
+    cmd: Command
+    ok: bool
+    actual: list[str]
+    rc: int
+
+    def diff(self) -> str:
+        out = [f"$ {self.cmd.cmd}   (line {self.cmd.line_no}, "
+               f"rc={self.rc} want {self.cmd.exit_code})"]
+        exp, act = self.cmd.expected, self.actual
+        for i in range(max(len(exp), len(act))):
+            e = exp[i] if i < len(exp) else "<missing>"
+            a = act[i] if i < len(act) else "<missing>"
+            mark = " " if i < len(exp) and i < len(act) and \
+                _match_line(e, a) else "!"
+            out.append(f"{mark} want: {e!r}")
+            if mark == "!":
+                out.append(f"  got : {a!r}")
+        return "\n".join(out)
+
+
+def run_transcript(
+    t_path: Path, workdir: Path, shim_dir: Path,
+    skip_cmd_res: list[str] | None = None,
+) -> list[CmdResult]:
+    """Run every command; returns results (ok flag per command).
+    skip_cmd_res: command regexes to skip (unsupported surface)."""
+    make_shims(shim_dir)
+    env = dict(
+        os.environ,
+        PATH=f"{shim_dir}:{os.environ['PATH']}",
+        TESTDIR=str(t_path.parent),
+        PYTHONPATH=str(REPO),
+        JAX_PLATFORMS="cpu",
+    )
+    cmds = [
+        c for c in parse_t(t_path)
+        if not (skip_cmd_res and any(re.search(p, c.cmd)
+                                     for p in skip_cmd_res))
+    ]
+    # one bash session so shell state (vars, files) persists; a sentinel
+    # after every command carries its exit status and splits the capture
+    sent = "__CRAM_SENTINEL__"
+    script_lines = ["exec 2>&1"]
+    for c in cmds:
+        script_lines.append(c.cmd)
+        script_lines.append(f'echo "{sent}$?"')
+    proc = subprocess.run(
+        ["bash", "-c", "\n".join(script_lines)], cwd=workdir, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    chunks: list[tuple[list[str], int]] = []
+    buf: list[str] = []
+    for line in proc.stdout.splitlines():
+        if line.startswith(sent):
+            chunks.append((buf, int(line[len(sent):] or 0)))
+            buf = []
+        else:
+            buf.append(line)
+    results: list[CmdResult] = []
+    for c, (actual, rc) in zip(cmds, chunks):
+        ok = rc == c.exit_code and len(actual) == len(c.expected) and all(
+            _match_line(e, a) for e, a in zip(c.expected, actual)
+        )
+        results.append(CmdResult(c, ok, actual, rc))
+    return results

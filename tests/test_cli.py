@@ -125,17 +125,19 @@ class TestOsdmaptool:
     def test_createsimple_and_stats(self, tmp_path, capsys):
         mf = str(tmp_path / "om.json")
         rc, out, err = run_cli(
-            osdmaptool, [mf, "--createsimple", "16", "--pg-bits", "4"],
+            osdmaptool, [mf, "--createsimple", "16", "--pg-bits", "4",
+                         "--with-default-pool"],
             capsys,
         )
-        assert rc == 0 and "writing epoch" in err
+        assert rc == 0 and "writing epoch" in out
         # bare simple map: all OSDs on one "localhost" host, so the
         # chooseleaf-host rule yields size-1 mappings (reference semantics)
         rc, out, err = run_cli(
-            osdmaptool, [mf, "--test-map-pgs", "--backend", "jax"], capsys
+            osdmaptool, [mf, "--mark-up-in", "--test-map-pgs",
+                         "--backend", "jax"], capsys
         )
         assert rc == 0
-        assert "pool 0 pg_num 256" in out
+        assert "pool 1 pg_num 256" in out
         assert "#osd\tcount\tfirst\tprimary\tc wt\twt" in out
         assert " in 16" in out
         assert re.search(r"size 1\t256", out)
@@ -145,8 +147,8 @@ class TestOsdmaptool:
         test-map-pgs.t): createsimple + import a crushtool --build map,
         then size==pool-size for every PG."""
         mf = str(tmp_path / "om.json")
-        run_cli(osdmaptool, [mf, "--createsimple", "16", "--pg-bits", "4"],
-                capsys)
+        run_cli(osdmaptool, [mf, "--createsimple", "16", "--pg-bits", "4",
+                             "--with-default-pool"], capsys)
         cf = str(tmp_path / "crush.txt")
         run_cli(
             crushtool,
@@ -165,43 +167,45 @@ class TestOsdmaptool:
 
     def test_backends_agree(self, tmp_path, capsys):
         mf = str(tmp_path / "om.json")
-        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "4"],
-                capsys)
+        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "4",
+                             "--with-default-pool"], capsys)
         _, out_jax, _ = run_cli(
-            osdmaptool, [mf, "--test-map-pgs", "--backend", "jax"], capsys
+            osdmaptool, [mf, "--mark-up-in", "--test-map-pgs",
+                         "--backend", "jax"], capsys
         )
         _, out_ref, _ = run_cli(
-            osdmaptool, [mf, "--test-map-pgs", "--backend", "ref"], capsys
+            osdmaptool, [mf, "--mark-up-in", "--test-map-pgs",
+                         "--backend", "ref"], capsys
         )
         assert out_jax == out_ref
 
     def test_dump_and_test_map_pg(self, tmp_path, capsys):
         mf = str(tmp_path / "om.json")
-        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "3"],
-                capsys)
+        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "3",
+                             "--with-default-pool"], capsys)
         rc, out, _ = run_cli(
-            osdmaptool, [mf, "--test-map-pgs-dump", "--backend", "ref"],
+            osdmaptool, [mf, "--mark-up-in", "--test-map-pgs-dump",
+                         "--backend", "ref"],
             capsys,
         )
         assert rc == 0
-        assert re.search(r"0\.0\t\[\d+(,\d+)*\]\t\d+", out)
-        rc, out, _ = run_cli(osdmaptool, [mf, "--test-map-pg", "0.5"],
+        assert re.search(r"1\.0\t\[\d+(,\d+)*\]\t\d+", out)
+        rc, out, _ = run_cli(osdmaptool, [mf, "--test-map-pg", "1.5"],
                              capsys)
-        assert "parsed '0.5'" in out
+        assert "parsed '1.5'" in out
 
     def test_upmap_writes_commands(self, tmp_path, capsys):
         mf = str(tmp_path / "om.json")
-        run_cli(osdmaptool, [mf, "--createsimple", "12", "--pg-bits", "5"],
-                capsys)
+        run_cli(osdmaptool, [mf, "--createsimple", "12", "--pg-bits", "5",
+                             "--with-default-pool"], capsys)
         uf = str(tmp_path / "upmaps.txt")
         rc, out, err = run_cli(
             osdmaptool,
-            [mf, "--upmap", uf, "--upmap-deviation", "1",
-             "--upmap-max", "20", "--backend", "ref"],
+            [mf, "--mark-up-in", "--upmap", uf, "--upmap-deviation", "1",
+             "--upmap-max", "20", "--backend", "ref", "--save"],
             capsys,
         )
         assert rc == 0
-        assert "Time elapsed" in err
         body = open(uf).read()
         # createsimple is flat (single host) => chooseleaf osd remaps exist
         for line in body.strip().splitlines():
@@ -217,12 +221,15 @@ class TestOsdmaptool:
 
     def test_export_import_crush(self, tmp_path, capsys):
         mf = str(tmp_path / "om.json")
-        run_cli(osdmaptool, [mf, "--createsimple", "4"], capsys)
+        run_cli(osdmaptool, [mf, "--createsimple", "4",
+                             "--with-default-pool"], capsys)
         cf = str(tmp_path / "cm.txt")
-        rc, _, err = run_cli(osdmaptool, [mf, "--export-crush", cf], capsys)
-        assert rc == 0 and "exported crush map" in err
-        rc, _, err = run_cli(osdmaptool, [mf, "--import-crush", cf], capsys)
-        assert rc == 0 and "imported crushmap" in err
+        rc, out, _ = run_cli(osdmaptool, [mf, "--export-crush", cf],
+                             capsys)
+        assert rc == 0 and "exported crush map" in out
+        rc, out, _ = run_cli(osdmaptool, [mf, "--import-crush", cf],
+                             capsys)
+        assert rc == 0 and "byte crush map" in out
 
 
 class TestEcBenchmark:
@@ -267,20 +274,23 @@ class TestUpmapCleanup:
         from ceph_tpu.osd.types import PgId
 
         mf = str(tmp_path / "om.json")
-        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "3"],
-                capsys)
+        run_cli(osdmaptool, [mf, "--createsimple", "8", "--pg-bits", "3",
+                             "--with-default-pool"], capsys)
         m = load_osdmap(mf)
-        # a no-op item (frm not in raw for sure: use an id not in mapping)
-        m.pg_upmap_items[PgId(0, 0)] = [(7, 6)]
-        raw, _ = m.pg_to_raw_osds(PgId(0, 0))
-        m.pg_upmap_items[PgId(0, 0)] = [(99, 5)]  # frm never in raw
-        m.pg_upmap[PgId(0, 1)] = list(raw)  # redundant for a different pg?
+        for o in range(m.max_osd):
+            m.mark_up_in(o)
+        raw, _ = m.pg_to_raw_osds(PgId(1, 0))
+        m.pg_upmap_items[PgId(1, 0)] = [(99, 5)]  # frm never in raw
+        m.pg_upmap[PgId(1, 1)] = list(raw)
         save_osdmap(m, mf)
-        rc, out, err = run_cli(osdmaptool, [mf, "--upmap-cleanup"], capsys)
+        # reference parity: --upmap-cleanup takes a file ('-' = stdout)
+        # and does NOT persist the cleaned map
+        rc, out, err = run_cli(osdmaptool, [mf, "--upmap-cleanup", "-"],
+                               capsys)
         assert rc == 0
-        assert "rm-pg-upmap-items" in out
+        assert "rm-pg-upmap-items 1.0" in out
         m2 = load_osdmap(mf)
-        assert PgId(0, 0) not in m2.pg_upmap_items
+        assert PgId(1, 0) in m2.pg_upmap_items  # not persisted
 
 
 class TestReweight:

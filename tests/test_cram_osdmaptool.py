@@ -1,0 +1,55 @@
+"""Replay the reference's osdmaptool cram transcripts against OUR CLI.
+
+The reference pins osdmaptool's exact CLI behavior — messages, output
+formats, exit codes, epoch bumps, even the upmap optimizer's concrete
+decisions — in cram transcripts (reference src/test/cli/osdmaptool/*.t).
+Passing them end-to-end proves drop-in compatibility of the whole stack:
+conf/builders, binary codec, print/tree formats, placement pipeline, and
+the upmap balancer.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from cramlib import run_transcript
+
+CRAM_DIR = Path("/root/reference/src/test/cli/osdmaptool")
+
+# (transcript, command-regexes to skip: surfaces we don't implement)
+TRANSCRIPTS = [
+    ("help.t", []),
+    ("missing-argument.t", []),
+    ("print-empty.t", []),
+    ("print-nonexistent.t", []),
+    ("clobber.t", []),
+    ("crush.t", []),
+    ("tree.t", []),
+    ("pool.t", []),
+    ("create-print.t", []),
+    ("create-racks.t", []),
+    ("test-map-pgs.t", []),
+    ("upmap.t", []),
+    ("upmap-out.t", []),
+]
+
+
+@pytest.mark.skipif(not CRAM_DIR.exists(),
+                    reason="reference cram transcripts unavailable")
+@pytest.mark.parametrize(
+    "name,skips", TRANSCRIPTS, ids=[t for t, _ in TRANSCRIPTS]
+)
+def test_transcript(name, skips, tmp_path):
+    t = CRAM_DIR / name
+    if not t.exists():
+        pytest.skip(f"{name} not in reference")
+    results = run_transcript(
+        t, workdir=tmp_path, shim_dir=tmp_path / "bin", skip_cmd_res=skips
+    )
+    bad = [r for r in results if not r.ok]
+    assert not bad, (
+        f"{len(bad)}/{len(results)} commands diverged; first:\n"
+        + bad[0].diff()
+    )

@@ -41,7 +41,7 @@ def test_replicated_clean(rng):
 
 def test_build_simple():
     m = build_simple(8, pg_bits=4)
-    check_pool(m, 0)
+    check_pool(m, 1)
 
 
 def test_replicated_down_out(rng):
