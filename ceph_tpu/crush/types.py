@@ -460,6 +460,97 @@ class CrushMap:
             self.max_devices = max(self.max_devices, item + 1)
         self.adjust_item_weight(item, int(round(weightf * 0x10000)))
 
+    def _detach_item(self, item: int) -> bool:
+        """Remove `item` from EVERY bucket holding it (shadow class trees
+        included), bubbling the weight delta up each chain.  Returns True
+        if it was held anywhere."""
+        found = False
+        for bid, holder in list(self.buckets.items()):
+            if item not in holder.items:
+                continue
+            found = True
+            j = holder.items.index(item)
+            delta = -holder.weights[j]
+            holder.items.pop(j)
+            holder.weights.pop(j)
+            holder.finalize_derived(self.tunables.straw_calc_version)
+            cur = bid
+            while delta:
+                parent = self.parent_of(cur)
+                if parent is None:
+                    break
+                pb = self.buckets[parent]
+                idx = pb.items.index(cur)
+                pb.weights[idx] += delta
+                pb.finalize_derived(self.tunables.straw_calc_version)
+                cur = parent
+        return found
+
+    def remove_item(self, item: int) -> bool:
+        """Detach a device/bucket from the tree and destroy its identity
+        (reference CrushWrapper::remove_item: bucket freed, name erased).
+        Returns True if found."""
+        found = self._detach_item(item)
+        if item < 0:
+            found = self.buckets.pop(item, None) is not None or found
+        self.item_names.pop(item, None)
+        if item >= 0:
+            self.item_classes.pop(item, None)
+        return found
+
+    def item_loc(self, item: int) -> dict[str, str]:
+        """{type_name: bucket_name} chain of the item's current ancestors
+        (non-shadow), for check_item_loc-style comparisons."""
+        shadows = {
+            sid for per in self.class_bucket.values()
+            for sid in per.values()
+        }
+        out: dict[str, str] = {}
+        cur = item
+        while True:
+            parent = next(
+                (bid for bid, b in self.buckets.items()
+                 if bid not in shadows and cur in b.items), None
+            )
+            if parent is None:
+                return out
+            b = self.buckets[parent]
+            out[self.type_names.get(b.type, str(b.type))] = \
+                self.item_names.get(parent, str(parent))
+            cur = parent
+
+    def item_weight(self, item: int) -> int | None:
+        """Current (non-shadow) weight of the item, or None if absent."""
+        shadows = {
+            sid for per in self.class_bucket.values()
+            for sid in per.values()
+        }
+        for bid, b in self.buckets.items():
+            if bid in shadows:
+                continue
+            if item in b.items:
+                return b.weights[b.items.index(item)]
+        return None
+
+    def create_or_move_item(
+        self, item: int, weightf: float, name: str, loc: dict[str, str]
+    ) -> bool:
+        """reference CrushWrapper::create_or_move_item: no-op when the
+        item already sits at loc; otherwise detach and re-insert, keeping
+        an existing item's current weight over the passed one.  Returns
+        True if the map changed."""
+        cur_loc = self.item_loc(item)
+        if cur_loc and all(cur_loc.get(t) == n for t, n in loc.items()
+                           if t in cur_loc):
+            return False  # already there
+        w = self.item_weight(item)
+        if w is not None:
+            weightf = w / 0x10000  # "resetting name/weight to current"
+        self._detach_item(item)
+        self.item_names.pop(item, None)
+        self.insert_item(item, weightf, name, loc)
+        return True
+
     def make_replicated_rule(
         self, root: int, failure_domain_type: int, num_rep: int = 0
     ) -> int:

@@ -73,6 +73,18 @@ def load_gf() -> ctypes.CDLL | None:
     return lib
 
 
+def load_crc() -> ctypes.CDLL | None:
+    lib = _load("ceph_tpu_crc", "crc.cpp", [])
+    if lib is None:
+        return None
+    lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
+    lib.ceph_tpu_crc32c.argtypes = [
+        ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.ceph_tpu_crc32c_hw.restype = ctypes.c_int
+    return lib
+
+
 def load_crush() -> ctypes.CDLL | None:
     lib = _load("ceph_tpu_crush", "crush.cpp", ["-pthread"])
     if lib is None:

@@ -147,3 +147,26 @@ class TestDout:
         assert "important" in out and "normal" in out
         assert "hidden" not in out
         assert log.enabled(5) and not log.enabled(6)
+
+
+class TestCrc32cEngines:
+    def test_fast_and_native_match_scalar(self):
+        import os
+
+        from ceph_tpu.utils.crc32c import (
+            _crc_bytes,
+            _load_native,
+            crc32c,
+            crc32c_fast,
+        )
+
+        rng_data = os.urandom(10_007)  # odd size: exercises the tail loop
+        ref = _crc_bytes(rng_data, 0xFFFFFFFF)
+        assert crc32c_fast(rng_data) == ref
+        assert crc32c(rng_data) == ref
+        lib = _load_native()
+        if lib is not None:
+            assert lib.ceph_tpu_crc32c(0xFFFFFFFF, rng_data,
+                                       len(rng_data)) == ref
+        # streaming chain equivalence
+        assert crc32c(rng_data[5000:], crc32c(rng_data[:5000])) == ref
