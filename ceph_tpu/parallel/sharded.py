@@ -79,6 +79,8 @@ class ShardedClusterMapper:
         self.pg_padded = ((self.pg_num + n - 1) // n) * n
         self._jit_map = None
         self._jit_step = None
+        # crush-weight target pinned at construction (rebalance_step)
+        self._target_w = jnp.asarray(self.pm.dev["weight"])
 
     # -- sharded mapping + stats ------------------------------------------
     def _build_map_fn(self):
@@ -134,22 +136,25 @@ class ShardedClusterMapper:
         vf = jax.vmap(fn, in_axes=(0, None, 0))
         axis = self.mesh.axis_names[0]
 
-        def local(ps, dev):
+        def local(ps, dev, target_w):
             _, _, acting, _ = vf(ps, dev, {})
             live = ps < pg_num
             hist = jax.lax.psum(_hist(acting, DV, live[:, None]), axis)
             # weight-proportional target (reference src/osd/OSDMap.cc:
             # 4707-4732 deviation build): target_i = pgs*R * w_i / sum(w)
+            # computed from the FIXED crush weights (target_w), not the
+            # per-iteration adjustment weights — the crush-compat balancer
+            # varies the weight-set while chasing the crush-weight target
+            # (reference pybind/mgr/balancer/module.py:1031 do_crush_compat)
+            tw = target_w.astype(jnp.float32)
+            target = (pg_num * R) * tw / jnp.maximum(jnp.sum(tw), 1.0)
             w = dev["weight"].astype(jnp.float32)
-            tw = jnp.sum(w)
-            target = (pg_num * R) * w / jnp.maximum(tw, 1.0)
             dev_f = hist.astype(jnp.float32) - target
             stddev = jnp.sqrt(
-                jnp.sum(dev_f * dev_f) / jnp.maximum(jnp.sum(w > 0), 1)
+                jnp.sum(dev_f * dev_f) / jnp.maximum(jnp.sum(tw > 0), 1)
             )
-            # crush-compat style multiplicative correction on the 16.16
-            # weights (the choose_args weight-set update of the balancer's
-            # crush-compat mode, reference pybind/mgr/balancer/module.py:90)
+            # multiplicative correction on the 16.16 adjustment weights
+            # (the choose_args weight-set update of crush-compat mode)
             ratio = target / jnp.maximum(hist.astype(jnp.float32), 1.0)
             ratio = jnp.clip(ratio, 0.5, 2.0)
             new_w = jnp.where(
@@ -162,7 +167,7 @@ class ShardedClusterMapper:
         sm = jax.shard_map(
             local,
             mesh=self.mesh,
-            in_specs=(P(axis), P()),
+            in_specs=(P(axis), P(), P()),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
@@ -170,10 +175,13 @@ class ShardedClusterMapper:
 
     def rebalance_step(self, weights=None):
         """One balancer iteration: map→histogram→deviation→weight update.
+        `weights` are the adjustment weights to map with (default: the
+        map's current in-weights); the deviation target always comes from
+        the initial weights captured at construction.
         Returns (new_weight u32[DV], stddev, pgs_per_osd)."""
         if self._jit_step is None:
             self._jit_step = self._build_step_fn()
         dev = dict(self.pm.dev)
         if weights is not None:
             dev["weight"] = jnp.asarray(weights, jnp.uint32)
-        return self._jit_step(self._ps(), dev)
+        return self._jit_step(self._ps(), dev, self._target_w)
