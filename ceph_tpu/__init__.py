@@ -18,8 +18,19 @@ The whole domain is integer math (uint32 hashes, s64 fixed-point logs), so
 the package enables jax_enable_x64 at import.
 """
 
-import jax
+import os
+import sys
 
-jax.config.update("jax_enable_x64", True)
+if "jax" in sys.modules:
+    # jax already loaded (e.g. the axon sitecustomize registered the TPU
+    # backend at interpreter start) — flip the config flag directly.
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+else:
+    # Defer the ~4s jax import for jax-free entry points (CLI tools, the
+    # codec/compiler layers are numpy-only); jax reads this env var when
+    # it eventually loads.
+    os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 __version__ = "0.1.0"

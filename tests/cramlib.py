@@ -77,7 +77,7 @@ def make_shims(shim_dir: Path) -> None:
         sh = shim_dir / tool
         sh.write_text(
             "#!/bin/sh\n"
-            f'PYTHONPATH="{REPO}" JAX_PLATFORMS=cpu '
+            f'PYTHONPATH="{REPO}" JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= '
             "TF_CPP_MIN_LOG_LEVEL=3 "  # silence XLA slow-op alarms
             f'exec python3 -u -m ceph_tpu.cli.{tool} "$@"\n'
         )
