@@ -30,7 +30,9 @@ if "jax" in sys.modules:
 else:
     # Defer the ~4s jax import for jax-free entry points (CLI tools, the
     # codec/compiler layers are numpy-only); jax reads this env var when
-    # it eventually loads.
-    os.environ.setdefault("JAX_ENABLE_X64", "true")
+    # it eventually loads.  Set unconditionally — an inherited
+    # JAX_ENABLE_X64=0 would silently downcast the s64 straw2/hash math
+    # to 32-bit; ensure_jax_backend() re-verifies the flag took effect.
+    os.environ["JAX_ENABLE_X64"] = "true"
 
 __version__ = "0.1.0"

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ceph_tpu.core.lntable import ln64k_table
+from ceph_tpu.core.lntable import crush_ln_scan_jax, ln64k_table
 from ceph_tpu.core.rjenkins import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from ceph_tpu.crush.soa import CrushArrays
 from ceph_tpu.crush.types import BucketAlg, ITEM_NONE, RuleOp
@@ -255,6 +255,280 @@ def _walk_bound(A: CrushArrays, start_slots, target_type: int) -> int:
 
 def _slots_of_type(A: CrushArrays, btype: int):
     return [s for s in range(A.n_buckets) if int(A.btype[s]) == btype]
+
+
+# --------------------------------------------------------------------------
+# Statically-unrolled, gather-free descent ("row path").
+#
+# XLA lowers data-dependent gathers on TPU to a serial scalar loop (~10
+# cycles per index — measured 190ms for one descent level's ln64k gathers
+# vs ~15ms for any fused arithmetic op over the same lanes), so the
+# generic _descend_impl above — whose fori_loop body gathers bucket rows
+# by traced slot and ln values by hash — is gather-bound.  The row path
+# removes every hot-loop gather:
+#
+# - The set of buckets reachable at each descent level is *static* (the
+#   rule names the TAKE bucket / the previous step's target type), so the
+#   descent unrolls into per-level steps over a precomputed reach set.
+#   Level 0 after TAKE is a single bucket: its tables fold to constants.
+# - Bucket rows (items / choose_args ids / weights / per-item outcome
+#   flags) for |reach| > 1 are fetched by a trace-time-unrolled select
+#   scan over the reach set — |reach| vector selects of constant rows,
+#   pure VPU lane arithmetic that fuses, instead of a serialized gather.
+# - crush_ln uses the 129+256-entry select-scan form
+#   (ceph_tpu.core.lntable.crush_ln_scan_jax) on accelerator backends;
+#   on CPU the 64k-table gather is faster and compiles quicker.
+# - Per-item *outcome* (found / skip / keep-descending vs the step's
+#   target type) is precomputed on host into the row tables, replacing
+#   the btype gather + comparison chain of the generic path.
+#
+# Levels whose reach contains bucket algorithms without a row-form
+# implementation (tree / uniform-perm) or whose reach exceeds
+# _REACH_SCAN_MAX fall back to the generic gather step for that level
+# only.  Bit-exactness is untouched: the row path computes the same
+# draws, same argmax tie-breaking, same status codes (differential suite
+# tests/test_mapper_jax.py covers both paths).
+# --------------------------------------------------------------------------
+
+_REACH_SCAN_MAX = 192  # larger reach sets use the gather fallback level
+
+# ROW field indices ([F, S] i32 per bucket)
+_RF_ITEM = 0   # item ids
+_RF_ID = 1     # choose_args ids (straw2 hash input)
+_RF_W = 2      # straw2 position-0 weights (u32 bit pattern)
+_RF_OUT = 3    # per-item descent outcome (_FOUND/_SKIP/_DESCENDING)
+_RF_STRAW = 4  # straw scalers (u32 bit pattern; straw buckets only)
+_RF_LW = 5     # list weights (u32)
+_RF_SW = 6     # list prefix sums (u32)
+# SCA field indices ([G] i32 per bucket)
+_SF_SIZE = 0
+_SF_ALG = 1
+_SF_BID = 2
+
+
+class _RowLevel:
+    """One descent level: reach set + packed constant row tables."""
+
+    def __init__(self, A: CrushArrays, reach: list[int], target_type: int):
+        self.reach = reach
+        algs = {int(A.alg[s]) for s in reach}
+        self.algs = algs
+        self.row_ok = (
+            algs <= {int(BucketAlg.STRAW2), int(BucketAlg.STRAW),
+                     int(BucketAlg.LIST)}
+            and len(reach) <= _REACH_SCAN_MAX
+            and A.positions == 1
+        )
+        if not self.row_ok:
+            return
+        S = A.max_size
+        F = 7 if int(BucketAlg.LIST) in algs or int(BucketAlg.STRAW) in algs \
+            else 4
+        self.F = F
+        row = np.zeros((len(reach), F, S), np.int32)
+        sca = np.zeros((len(reach), 3), np.int32)
+        for k, s in enumerate(reach):
+            n = int(A.size[s])
+            row[k, _RF_ITEM] = A.items[s]
+            row[k, _RF_ID] = A.arg_ids[s]
+            row[k, _RF_W] = A.pos_weights[0, s].view(np.int32)
+            out = np.full(S, _SKIP, np.int32)
+            for j in range(n):
+                it = int(A.items[s, j])
+                if it < 0:
+                    cs = -1 - it
+                    if cs >= A.n_buckets:
+                        out[j] = _SKIP  # dangling bucket ref
+                    elif int(A.btype[cs]) == target_type:
+                        out[j] = _FOUND
+                    else:
+                        out[j] = _DESCENDING
+                else:
+                    if it >= A.max_devices:
+                        out[j] = _SKIP
+                    else:
+                        out[j] = _FOUND if target_type == 0 else _SKIP
+            row[k, _RF_OUT] = out
+            if F > 4:
+                row[k, _RF_STRAW] = A.straws[s].view(np.int32)
+                row[k, _RF_LW] = A.weights[s].view(np.int32)
+                row[k, _RF_SW] = A.sum_weights[s].view(np.int32)
+            sca[k] = (n, int(A.alg[s]), -1 - s)
+        self.ROW = row
+        self.SCA = sca
+
+
+def _prep_levels(A: CrushArrays, start_slots, target_type: int):
+    """Static per-level reach analysis from start_slots until items of
+    target_type emerge.  Returns a list of _RowLevel (may be empty when
+    start_slots is empty — caller falls back to the generic descent)."""
+    levels: list[_RowLevel] = []
+    cur = sorted(set(start_slots))
+    for _ in range(A.max_depth + 1):
+        if not cur:
+            break
+        levels.append(_RowLevel(A, cur, target_type))
+        nxt = set()
+        for s in cur:
+            for it in A.items[s][: int(A.size[s])]:
+                it = int(it)
+                cs = -1 - it
+                if it < 0 and cs < A.n_buckets and (
+                    int(A.btype[cs]) != target_type
+                ):
+                    nxt.add(cs)
+        cur = sorted(nxt)
+    return levels
+
+
+def _scan_rows(lv: _RowLevel, slot):
+    """Select-scan the level's packed tables by traced slot scalar."""
+    row = jnp.asarray(lv.ROW[0])
+    sca = jnp.asarray(lv.SCA[0])
+    for k, s in enumerate(lv.reach[1:], start=1):
+        m = slot == s
+        row = jnp.where(m, jnp.asarray(lv.ROW[k]), row)
+        sca = jnp.where(m, jnp.asarray(lv.SCA[k]), sca)
+    return row, sca
+
+
+def _rowpick(row, am):
+    """row[am] without a gather (one-hot sum over the S lanes)."""
+    lane = jnp.arange(row.shape[-1])
+    return jnp.sum(jnp.where(lane == am, row, 0), axis=-1)
+
+
+def _u32row(row):
+    return row.astype(jnp.int64) & 0xFFFFFFFF
+
+
+def _ln_fn(u):
+    """crush_ln(u) for u = hash & 0xffff: select-scan on accelerators,
+    64k-table gather on CPU (gathers are cheap there, giant select chains
+    are slow to compile)."""
+    import jax as _jax
+
+    if _jax.default_backend() == "cpu":
+        return jnp.asarray(ln64k_table())[u]
+    return crush_ln_scan_jax(u)
+
+
+def _straw2_rows(row, size, x, r):
+    """Row-table straw2 (same math as _straw2_choose)."""
+    w = _u32row(row[_RF_W])
+    u = (_h3(x, row[_RF_ID], r) & 0xFFFF).astype(jnp.uint32)
+    ln = _ln_fn(u) - jnp.int64(0x1000000000000)
+    draw = lax.div(ln, jnp.maximum(w, 1))
+    mask = jnp.arange(row.shape[-1]) < size
+    draw = jnp.where((w > 0) & mask, draw, S64_MIN)
+    return jnp.argmax(draw)
+
+
+def _straw_rows(row, size, x, r):
+    """Row-table straw (same math as _straw_choose)."""
+    draw = (_h3(x, row[_RF_ITEM], r) & 0xFFFF).astype(jnp.uint64) * _u32row(
+        row[_RF_STRAW]
+    ).astype(jnp.uint64)
+    mask = jnp.arange(row.shape[-1]) < size
+    draw = jnp.where(mask, draw, 0)
+    return jnp.argmax(draw)
+
+
+def _list_rows(row, size, bid, x, r):
+    """Row-table list choose (same math as _list_choose)."""
+    lane = jnp.arange(row.shape[-1])
+    w = (_h4(x, row[_RF_ITEM], r, bid) & 0xFFFF).astype(jnp.uint64)
+    w = (w * _u32row(row[_RF_SW]).astype(jnp.uint64)) >> 16
+    ok = (w < _u32row(row[_RF_LW]).astype(jnp.uint64)) & (lane < size)
+    best = jnp.max(jnp.where(ok, lane, -1))
+    return jnp.maximum(best, 0)
+
+
+def _row_level_step(d: _DeviceArrays, lv: _RowLevel, x, item, r_fn):
+    """One unrolled descent level on the row path.  Returns
+    (nxt, new_status_ignoring_active, r_cur)."""
+    A = d.A
+    slot = jnp.clip(-1 - item, 0, A.n_buckets - 1)
+    row, sca = _scan_rows(lv, slot)
+    size, alg, bid = sca[_SF_SIZE], sca[_SF_ALG], sca[_SF_BID]
+    r_cur = r_fn(alg, size)
+    fns = []
+    if int(BucketAlg.STRAW2) in lv.algs:
+        fns.append((int(BucketAlg.STRAW2),
+                    lambda: _straw2_rows(row, size, x, r_cur)))
+    if int(BucketAlg.STRAW) in lv.algs:
+        fns.append((int(BucketAlg.STRAW),
+                    lambda: _straw_rows(row, size, x, r_cur)))
+    if int(BucketAlg.LIST) in lv.algs:
+        fns.append((int(BucketAlg.LIST),
+                    lambda: _list_rows(row, size, bid, x, r_cur)))
+    am = fns[0][1]()
+    for a, f in fns[1:]:
+        am = jnp.where(alg == a, f(), am)
+    nxt = _rowpick(row[_RF_ITEM], am)
+    outcome = _rowpick(row[_RF_OUT], am)
+    empty = size == 0
+    new_status = jnp.where(empty, jnp.int32(_EMPTY), outcome)
+    return jnp.where(empty, item, nxt), new_status, r_cur
+
+
+def _gather_level_step(d: _DeviceArrays, x, item, r_fn, position,
+                       target_type: int):
+    """Generic (gather-based) level step — fallback for levels whose reach
+    has no row form; same logic as one _descend_impl body iteration."""
+    A = d.A
+    slot = jnp.clip(-1 - item, 0, A.n_buckets - 1)
+    empty = d.size[slot] == 0
+    r_cur = r_fn(d.alg[slot], d.size[slot])
+    nxt = _bucket_choose(d, slot, x, r_cur, position)
+    bad = nxt >= A.max_devices
+    is_b = nxt < 0
+    dangling = is_b & (-1 - nxt >= A.n_buckets)
+    nslot = jnp.clip(-1 - nxt, 0, A.n_buckets - 1)
+    ntype = jnp.where(is_b, d.btype[nslot], 0)
+    new_status = jnp.where(
+        empty,
+        jnp.int32(_EMPTY),
+        jnp.where(
+            bad | dangling,
+            jnp.int32(_SKIP),
+            jnp.where(
+                ntype == target_type,
+                jnp.int32(_FOUND),
+                jnp.where(~is_b, jnp.int32(_SKIP), jnp.int32(_DESCENDING)),
+            ),
+        ),
+    )
+    return jnp.where(empty, item, nxt), new_status, r_cur
+
+
+def _descend_rows(d: _DeviceArrays, x, start_item, r_fn, position,
+                  target_type: int, levels: list[_RowLevel]):
+    """Unrolled descent over precomputed levels (row path with per-level
+    gather fallback).  r_fn(alg_scalar, size_scalar) -> replica draw for
+    the current bucket (constant for firstn; stride-adjusted for indep).
+    Returns (item, status, r_last) like _descend_impl."""
+    A = d.A
+    status = jnp.where(
+        (start_item < 0) & (-1 - start_item < A.n_buckets),
+        jnp.int32(_DESCENDING),
+        jnp.int32(_SKIP),
+    )
+    item = jnp.asarray(start_item, jnp.int32)
+    r_last = jnp.int32(0)
+    for lv in levels:
+        active = status == _DESCENDING
+        if lv.row_ok:
+            nxt, new_status, r_cur = _row_level_step(d, lv, x, item, r_fn)
+        else:
+            nxt, new_status, r_cur = _gather_level_step(
+                d, x, item, r_fn, position, target_type
+            )
+        item = jnp.where(active, nxt, item)
+        status = jnp.where(active, new_status, status)
+        r_last = jnp.where(active, r_cur, r_last).astype(jnp.int32)
+    status = jnp.where(status == _DESCENDING, jnp.int32(_SKIP), status)
+    return item, status, r_last
 
 
 def _descend_impl(
@@ -662,6 +936,8 @@ def _choose_firstn_one_fast(
     window: int,
     bound: int | None = None,
     leaf_bound: int | None = None,
+    levels: list | None = None,
+    leaf_levels: list | None = None,
 ):
     """Vectorized crush_choose_firstn (same semantics as
     _choose_firstn_one; reference src/crush/mapper.c:460-648).
@@ -694,9 +970,16 @@ def _choose_firstn_one_fast(
     NR = out_bound
     T = min(numrep + tries - 1, window)
     rs = jnp.arange(T, dtype=jnp.int32)
-    cand, status = jax.vmap(
-        lambda r: _descend(d, x, src, r, 0, target_type, bound)
-    )(rs)
+    if levels:
+        cand, status, _ = jax.vmap(
+            lambda r: _descend_rows(
+                d, x, src, lambda alg, size: r, 0, target_type, levels
+            )
+        )(rs)
+    else:
+        cand, status = jax.vmap(
+            lambda r: _descend(d, x, src, r, 0, target_type, bound)
+        )(rs)
     found = status == _FOUND
     skip = status == _SKIP
 
@@ -763,11 +1046,20 @@ def _choose_firstn_one_fast(
     else:
         sub_r = jnp.zeros_like(sel_rv)
     ks = jnp.arange(Rt, dtype=jnp.int32)
-    leaf, lstat = jax.vmap(
-        lambda c, sr: jax.vmap(
-            lambda k: _descend(d, x, c, sr + k, 0, 0, leaf_bound)
-        )(ks)
-    )(sel_cand, sub_r)  # [numrep, Rt]
+    if leaf_levels:
+        leaf, lstat, _ = jax.vmap(
+            lambda c, sr: jax.vmap(
+                lambda k: _descend_rows(
+                    d, x, c, lambda alg, size: sr + k, 0, 0, leaf_levels
+                )
+            )(ks)
+        )(sel_cand, sub_r)  # [numrep, Rt]
+    else:
+        leaf, lstat = jax.vmap(
+            lambda c, sr: jax.vmap(
+                lambda k: _descend(d, x, c, sr + k, 0, 0, leaf_bound)
+            )(ks)
+        )(sel_cand, sub_r)  # [numrep, Rt]
     leaf_sel = (lstat == _FOUND) & ~_is_out(x, leaf, dev_weights, weight_max)
     leaf_skip = lstat == _SKIP
     # a leaf attempt aborts at the first _SKIP (C returns <= outpos)
@@ -814,6 +1106,8 @@ def _choose_indep_one_fast(
     out_bound: int,
     bound: int | None = None,
     leaf_bound: int | None = None,
+    levels: list | None = None,
+    leaf_levels: list | None = None,
 ):
     """crush_choose_indep with the per-round rep descents vectorized.
 
@@ -832,24 +1126,50 @@ def _choose_indep_one_fast(
     Rt = recurse_tries
     ks = jnp.arange(Rt, dtype=jnp.int32)
 
+    def indep_r_fn(rep_base, ftotal):
+        def r_fn(alg, size):
+            uni = (alg == int(BucketAlg.UNIFORM)) & (size % numrep == 0)
+            return (
+                rep_base + jnp.where(uni, numrep + 1, numrep) * ftotal
+            ).astype(jnp.int32)
+        return r_fn
+
     def round_body(st):
         ftotal, left, out, out2 = st
-        cand, status, r_last = jax.vmap(
-            lambda rep: _descend_indep(
-                d, x, src, rep, ftotal, numrep, 0, target_type, bound
-            )
-        )(reps)
+        if levels:
+            cand, status, r_last = jax.vmap(
+                lambda rep: _descend_rows(
+                    d, x, src, indep_r_fn(rep, ftotal), 0, target_type,
+                    levels,
+                )
+            )(reps)
+        else:
+            cand, status, r_last = jax.vmap(
+                lambda rep: _descend_indep(
+                    d, x, src, rep, ftotal, numrep, 0, target_type, bound
+                )
+            )(reps)
         cand_out = _is_out(x, cand, dev_weights, weight_max)
         if recurse_to_leaf:
             # leaf retry loop (reference src/crush/mapper.c:784-798)
             # unrolled over the k axis: first good k before the first skip
-            leaf, lstat, _ = jax.vmap(
-                lambda c, pr, rep: jax.vmap(
-                    lambda k: _descend_indep(
-                        d, x, c, rep + pr, k, numrep, rep, 0, leaf_bound
-                    )
-                )(ks)
-            )(cand, r_last, reps)  # [NR, Rt]
+            if leaf_levels:
+                leaf, lstat, _ = jax.vmap(
+                    lambda c, pr, rep: jax.vmap(
+                        lambda k: _descend_rows(
+                            d, x, c, indep_r_fn(rep + pr, k), rep, 0,
+                            leaf_levels,
+                        )
+                    )(ks)
+                )(cand, r_last, reps)  # [NR, Rt]
+            else:
+                leaf, lstat, _ = jax.vmap(
+                    lambda c, pr, rep: jax.vmap(
+                        lambda k: _descend_indep(
+                            d, x, c, rep + pr, k, numrep, rep, 0, leaf_bound
+                        )
+                    )(ks)
+                )(cand, r_last, reps)  # [NR, Rt]
             lgood = (lstat == _FOUND) & ~_is_out(
                 x, leaf, dev_weights, weight_max
             )

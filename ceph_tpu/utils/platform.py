@@ -23,6 +23,11 @@ def ensure_jax_backend() -> str:
         return _checked
     import jax
 
+    # x64 is load-bearing (s64 straw2 draws, u64 ln math): another library
+    # may have imported jax after mutating the env, or flipped the flag —
+    # a silent 32-bit downcast would produce wrong placements, so force it.
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
     try:
         jax.devices()
         _checked = jax.default_backend()

@@ -77,9 +77,12 @@ def make_shims(shim_dir: Path) -> None:
         sh = shim_dir / tool
         sh.write_text(
             "#!/bin/sh\n"
-            f'PYTHONPATH="{REPO}" JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= '
+            # env -u: actually unset the axon pool var (an empty value
+            # would still count as "present" to presence-checking readers)
+            f'exec env -u PALLAS_AXON_POOL_IPS PYTHONPATH="{REPO}" '
+            "JAX_PLATFORMS=cpu "
             "TF_CPP_MIN_LOG_LEVEL=3 "  # silence XLA slow-op alarms
-            f'exec python3 -u -m ceph_tpu.cli.{tool} "$@"\n'
+            f'python3 -u -m ceph_tpu.cli.{tool} "$@"\n'
         )
         sh.chmod(0o755)
 
@@ -120,6 +123,9 @@ def run_transcript(
         PYTHONPATH=str(REPO),
         JAX_PLATFORMS="cpu",
     )
+    # same accelerator isolation as the shims, for commands that invoke
+    # python directly rather than through them
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     cmds = [
         c for c in parse_t(t_path)
         if not (skip_cmd_res and any(re.search(p, c.cmd)

@@ -1,0 +1,237 @@
+"""On-chip performance probe for the CRUSH mapping kernel.
+
+Answers, with measured numbers (committed to PROFILE_r04.md):
+
+1. block-count scaling — same compiled fn, same 65536-PG chunk, k blocks
+   dispatched per rep for k in 1..16: is per-block wall time flat?
+   Variants isolate dispatch/transfer effects:
+     a. hold    — dispatch all k, block at the end, keep outputs on device
+                  (what bench.py r03 did)
+     b. fetch   — np.asarray each block's outputs immediately (device->host
+                  transfer per block, nothing accumulates on device)
+     c. serial  — block_until_ready after each dispatch (no queueing)
+     d. repeat1 — dispatch the SAME block k times (input reuse; tests
+                  whether distinct input buffers matter)
+2. straw2 ablations — the headline kernel recompiled with the inner straw2
+   draw altered (results become wrong; timing only):
+     a. baseline    — s64 table-gather + s64 divide (the real kernel)
+     b. nodiv       — divide replaced by multiply
+     c. nogather    — 64k-entry s64 table gather replaced by arithmetic
+                      crush_ln (jnp path, small tables)
+     d. noint64     — draw computed in int32 (truncated)
+   The deltas bound how much of the per-PG cost each suspect owns.
+3. jax.profiler trace — attempted around one rep; written to
+   tools/profile_trace/ when the backend supports it.
+
+Usage: python tools/perf_probe.py [--pgs N] [--osds N] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def log(msg):
+    print(f"probe[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def build_map(n_pgs, n_osds):
+    from ceph_tpu.osd.osdmap import build_hierarchical
+    from ceph_tpu.osd.types import PgPool, PoolType
+
+    n_host = max(1, n_osds // 8)
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=n_pgs, pgp_num=n_pgs)
+    return build_hierarchical(n_host, 8, n_rack=max(1, n_host // 16),
+                              pool=pool)
+
+
+def make_fn(m):
+    import jax
+
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+    pm = PoolMapper(m, 0, overlays=False)
+    fn = jax.jit(jax.vmap(pm._fast, in_axes=(0, None, 0)))
+    dev = jax.device_put(pm.dev)
+    return pm, fn, dev
+
+
+def probe_scaling(m, B=65536, ks=(1, 2, 4, 8, 16), reps=2):
+    import jax
+    import jax.numpy as jnp
+
+    pm, fn, dev = make_fn(m)
+    n_pgs = pm.spec.pg_num
+    blocks = [
+        jax.device_put(jnp.asarray(
+            (np.arange(i * B, (i + 1) * B) % n_pgs).astype(np.uint32)))
+        for i in range(max(ks))
+    ]
+    out = fn(blocks[0], dev, {})
+    jax.block_until_ready(out)
+
+    res = {}
+    for k in ks:
+        row = {}
+        # a. hold: r03 bench pattern
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            outs = [fn(b, dev, {}) for b in blocks[:k]]
+            jax.block_until_ready(outs)
+        row["hold_s_per_block"] = (time.perf_counter() - t0) / reps / k
+        del outs
+        # b. fetch each block to host immediately
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for b in blocks[:k]:
+                o = fn(b, dev, {})
+                _ = [np.asarray(x) for x in o]
+        row["fetch_s_per_block"] = (time.perf_counter() - t0) / reps / k
+        # c. serial: block after each dispatch, keep on device
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for b in blocks[:k]:
+                jax.block_until_ready(fn(b, dev, {}))
+        row["serial_s_per_block"] = (time.perf_counter() - t0) / reps / k
+        # d. same block k times
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            outs = [fn(blocks[0], dev, {}) for _ in range(k)]
+            jax.block_until_ready(outs)
+        row["repeat1_s_per_block"] = (time.perf_counter() - t0) / reps / k
+        del outs
+        res[k] = {kk: round(v, 4) for kk, v in row.items()}
+        log(f"scaling k={k}: {res[k]}")
+    return res
+
+
+def probe_ablations(m, B=65536, reps=3):
+    """Recompile the pipeline with the straw2 inner ops ablated."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ceph_tpu.core.lntable import crush_ln_jax, ln64k_table
+    from ceph_tpu.crush import mapper_jax
+
+    S64_MIN = mapper_jax.S64_MIN
+    _h3 = mapper_jax._h3
+    orig = mapper_jax._straw2_choose
+
+    def straw2_variant(divide, gather, sixtyfour):
+        def f(d, slot, x, r, position):
+            A = d.A
+            pos = jnp.clip(position, 0, A.positions - 1)
+            w = d.pos_weights[pos, slot].astype(jnp.int64)
+            ids = d.arg_ids[slot]
+            lane = jnp.arange(A.max_size)
+            mask = lane < d.size[slot]
+            u = (_h3(x, ids, r) & 0xFFFF).astype(jnp.uint32)
+            if gather:
+                ln = jnp.asarray(ln64k_table())[u] - jnp.int64(0x1000000000000)
+            else:
+                ln = crush_ln_jax(u).astype(jnp.int64) - jnp.int64(
+                    0x1000000000000)
+            if not sixtyfour:
+                ln32 = (ln >> 20).astype(jnp.int32)
+                w32 = jnp.maximum(w, 1).astype(jnp.int32)
+                draw = (lax.div(ln32, w32) if divide else ln32 * w32)
+                draw = jnp.where((w > 0) & mask, draw, -(2 ** 31))
+                return d.items[slot, jnp.argmax(draw)]
+            draw = (lax.div(ln, jnp.maximum(w, 1)) if divide
+                    else ln * jnp.maximum(w, 1))
+            draw = jnp.where((w > 0) & mask, draw, S64_MIN)
+            return d.items[slot, jnp.argmax(draw)]
+        return f
+
+    variants = {
+        "baseline": straw2_variant(True, True, True),
+        "nodiv": straw2_variant(False, True, True),
+        "nogather": straw2_variant(True, False, True),
+        "nodiv_nogather": straw2_variant(False, False, True),
+        "noint64": straw2_variant(True, True, False),
+        "noint64_nodiv": straw2_variant(False, True, False),
+    }
+    xs = np.arange(B, dtype=np.uint32)
+    out = {}
+    for name, v in variants.items():
+        mapper_jax._straw2_choose = v
+        try:
+            pm, fn, dev = make_fn(m)
+            import jax
+            xj = jax.device_put(jnp.asarray(xs))
+            t0 = time.perf_counter()
+            o = fn(xj, dev, {})
+            jax.block_until_ready(o)
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                jax.block_until_ready(fn(xj, dev, {}))
+            dt = (time.perf_counter() - t0) / reps
+            out[name] = {"s_per_block": round(dt, 4),
+                         "maps_per_sec": round(B / dt, 1),
+                         "compile_s": round(compile_s, 1)}
+            log(f"ablation {name}: {out[name]}")
+        finally:
+            mapper_jax._straw2_choose = orig
+    return out
+
+
+def probe_trace(m, B=65536):
+    import jax
+    import jax.numpy as jnp
+
+    pm, fn, dev = make_fn(m)
+    xs = jax.device_put(jnp.asarray(np.arange(B, dtype=np.uint32)))
+    jax.block_until_ready(fn(xs, dev, {}))
+    tdir = Path(__file__).resolve().parent / "profile_trace"
+    try:
+        with jax.profiler.trace(str(tdir)):
+            jax.block_until_ready(fn(xs, dev, {}))
+        files = [str(p.relative_to(tdir)) for p in tdir.rglob("*") if
+                 p.is_file()]
+        return {"ok": True, "dir": str(tdir), "files": files[:20]}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pgs", type=int, default=1_048_576)
+    ap.add_argument("--osds", type=int, default=1024)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--skip", default="", help="comma list: scaling,"
+                    "ablations,trace")
+    args = ap.parse_args()
+    skip = set(args.skip.split(","))
+
+    import jax
+    log(f"devices: {jax.devices()}")
+    from bench import _enable_compile_cache
+    _enable_compile_cache()
+
+    m = build_map(args.pgs, args.osds)
+    res = {"pgs": args.pgs, "osds": args.osds,
+           "device": str(jax.devices()[0])}
+    ks = (1, 4, 16) if args.quick else (1, 2, 4, 8, 16)
+    if "scaling" not in skip:
+        res["scaling"] = probe_scaling(m, ks=ks)
+    if "ablations" not in skip:
+        res["ablations"] = probe_ablations(m)
+    if "trace" not in skip:
+        res["trace"] = probe_trace(m)
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()
