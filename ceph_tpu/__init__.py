@@ -30,9 +30,21 @@ if "jax" in sys.modules:
 else:
     # Defer the ~4s jax import for jax-free entry points (CLI tools, the
     # codec/compiler layers are numpy-only); jax reads this env var when
-    # it eventually loads.  Set unconditionally — an inherited
+    # it eventually loads.  x64 is load-bearing — an inherited
     # JAX_ENABLE_X64=0 would silently downcast the s64 straw2/hash math
-    # to 32-bit; ensure_jax_backend() re-verifies the flag took effect.
+    # to 32-bit — so we override, but warn when clobbering an explicit
+    # conflicting setting; ensure_jax_backend() re-verifies the flag took.
+    _prev = os.environ.get("JAX_ENABLE_X64")
+    if _prev is not None and _prev.lower() not in (
+        "true", "1", "y", "yes", "t", "on"
+    ):
+        import warnings
+
+        warnings.warn(
+            f"ceph_tpu requires 64-bit jax types; overriding "
+            f"JAX_ENABLE_X64={_prev!r} with 'true' process-wide",
+            stacklevel=2,
+        )
     os.environ["JAX_ENABLE_X64"] = "true"
 
 __version__ = "0.1.0"

@@ -187,7 +187,7 @@ def crush_ln_scan_jax(xin):
     trace-time-unrolled select chains: 129 paired (RH,LH) selects + 256 LL
     selects of constant values, all VPU lane arithmetic that fuses into the
     surrounding straw2 kernel.  Bit-exact with crush_ln_np (tested over the
-    full 2^16 input domain in tests/test_core.py).
+    full 2^16 input domain in tests/test_core_numerics.py).
 
     xin: int32/uint32 array of u = hash & 0xffff values (<= 0xffff).
     Returns int64 crush_ln values.
@@ -221,6 +221,87 @@ def crush_ln_scan_jax(xin):
     ll = jnp.full(j.shape, int(LL_TBL[0]), jnp.int64)
     for i in range(1, 256):
         ll = jnp.where(j == i, jnp.int64(int(LL_TBL[i])), ll)
+
+    return (iexpon.astype(jnp.int64) << 44) + ((lh + ll) >> 4)
+
+
+_OH_TBL1 = None  # [129, 5] f32: rh limbs 24/24/1, lh limbs 24/24
+_OH_TBL2 = None  # [256, 2] f32: ll limbs 24/24
+
+
+def _onehot_tables():
+    global _OH_TBL1, _OH_TBL2
+    if _OH_TBL1 is None:
+        rh = RH_LH_TBL[0::2][:129].astype(np.int64)
+        lh = RH_LH_TBL[1::2][:129].astype(np.int64)
+        _OH_TBL1 = np.stack(
+            [
+                (rh & 0xFFFFFF).astype(np.float32),
+                ((rh >> 24) & 0xFFFFFF).astype(np.float32),
+                (rh >> 48).astype(np.float32),
+                (lh & 0xFFFFFF).astype(np.float32),
+                ((lh >> 24) & 0xFFFFFF).astype(np.float32),
+            ],
+            axis=1,
+        )
+        _OH_TBL2 = np.stack(
+            [
+                (LL_TBL & 0xFFFFFF).astype(np.float32),
+                ((LL_TBL >> 24) & 0xFFFFFF).astype(np.float32),
+            ],
+            axis=1,
+        )
+    return _OH_TBL1, _OH_TBL2
+
+
+def crush_ln_onehot_jax(xin):
+    """crush_ln as one-hot MXU matmuls — the large-batch TPU hot-path form.
+
+    Same normalize arithmetic as crush_ln_scan_jax, but the RH/LH and LL
+    table lookups contract a one-hot row vector against the tables split
+    into 24-bit limb planes: f32 holds any 24-bit integer exactly and a
+    one-hot contraction touches exactly one row, so reconstruction is
+    bit-exact while the lookup cost rides the MXU instead of a serialized
+    VPU select chain.  Bit-exact with crush_ln_np over the full 2^16 input
+    domain (tests/test_core_numerics.py).
+    """
+    import jax.numpy as jnp
+
+    t1, t2 = _onehot_tables()
+    x = jnp.asarray(xin).astype(jnp.int32) + 1  # in [1, 0x10000]
+    iex = jnp.zeros_like(x)
+    xs = x
+    for s in (16, 8, 4, 2, 1):
+        g = xs >= (1 << s)
+        iex = iex + jnp.where(g, s, 0)
+        xs = jnp.where(g, xs >> s, xs)
+    iexpon = jnp.minimum(iex, 15)
+    xn = x << jnp.clip(15 - iex, 0, 15)
+    k = (xn >> 8) - 128  # RH/LH row, in [0, 128]
+
+    oh1 = (k[..., None] == jnp.arange(129, dtype=jnp.int32)).astype(
+        jnp.float32
+    )
+    v1 = jnp.matmul(
+        oh1, jnp.asarray(t1), precision="highest", preferred_element_type=jnp.float32
+    )  # [..., 5]
+    rh = (
+        v1[..., 0].astype(jnp.int64)
+        + (v1[..., 1].astype(jnp.int64) << 24)
+        + (v1[..., 2].astype(jnp.int64) << 48)
+    )
+    lh = v1[..., 3].astype(jnp.int64) + (v1[..., 4].astype(jnp.int64) << 24)
+
+    # bits 48..55 of xn*rh; two's-complement wrap preserves the low 64 bits
+    # so s64 multiply is safe even when the product reaches 2^63
+    j = ((xn.astype(jnp.int64) * rh) >> 48).astype(jnp.int32) & 0xFF
+    oh2 = (j[..., None] == jnp.arange(256, dtype=jnp.int32)).astype(
+        jnp.float32
+    )
+    v2 = jnp.matmul(
+        oh2, jnp.asarray(t2), precision="highest", preferred_element_type=jnp.float32
+    )  # [..., 2]
+    ll = v2[..., 0].astype(jnp.int64) + (v2[..., 1].astype(jnp.int64) << 24)
 
     return (iexpon.astype(jnp.int64) << 44) + ((lh + ll) >> 4)
 

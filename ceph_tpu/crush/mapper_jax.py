@@ -34,7 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ceph_tpu.core.lntable import crush_ln_scan_jax, ln64k_table
+from ceph_tpu.core.lntable import (
+    crush_ln_onehot_jax,
+    crush_ln_scan_jax,
+    ln64k_table,
+)
 from ceph_tpu.core.rjenkins import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from ceph_tpu.crush.soa import CrushArrays
 from ceph_tpu.crush.types import BucketAlg, ITEM_NONE, RuleOp
@@ -290,7 +294,9 @@ def _slots_of_type(A: CrushArrays, btype: int):
 # tests/test_mapper_jax.py covers both paths).
 # --------------------------------------------------------------------------
 
-_REACH_SCAN_MAX = 192  # larger reach sets use the gather fallback level
+_REACH_SCAN_MAX = 8192  # larger reach sets use the gather fallback level
+_REACH_ONEHOT_MIN = 24  # reach sets this big fetch rows by one-hot matmul
+                        # (MXU) instead of a trace-unrolled select chain
 
 # ROW field indices ([F, S] i32 per bucket)
 _RF_ITEM = 0   # item ids
@@ -300,10 +306,41 @@ _RF_OUT = 3    # per-item descent outcome (_FOUND/_SKIP/_DESCENDING)
 _RF_STRAW = 4  # straw scalers (u32 bit pattern; straw buckets only)
 _RF_LW = 5     # list weights (u32)
 _RF_SW = 6     # list prefix sums (u32)
+_RF_M0 = 7     # straw2 divide-free reciprocal: limb 0 (bits 0-23 of m)
+_RF_M1 = 8     # limb 1 (bits 24-47)
+_RF_M2 = 9     # limb 2 (bits 48+, < 2^2)
+_RF_L = 10     # shift l = ceil(log2 w); draw = -(n*m >> (49+l))
+_N_RF = 11
 # SCA field indices ([G] i32 per bucket)
 _SF_SIZE = 0
 _SF_ALG = 1
 _SF_BID = 2
+
+
+FORCE_ROW_PATH: bool | None = None  # tests override; None = auto
+
+
+def _use_row_path() -> bool:
+    """Row path on accelerators (where gathers serialize); gather/fori path
+    on CPU (gathers are cheap there, giant unrolled selects compile slowly)."""
+    import jax as _jax
+
+    if FORCE_ROW_PATH is not None:
+        return FORCE_ROW_PATH
+    return _jax.default_backend() != "cpu"
+
+
+def _magic_div_consts(w: int) -> tuple[int, int]:
+    """Granlund-Montgomery invariant-divisor constants for the straw2 draw:
+    floor(n / w) == (n * m) >> (49 + l) for all 0 <= n <= 2^48, where
+    l = ceil(log2 w) and m = ceil(2^(49+l) / w).  Proof obligation
+    (m*w - 2^(49+l)) < 2^l holds since the residue is < w <= 2^l; the n
+    range covers crush_ln's full output (n = 2^48 at u=0).  Verified
+    exhaustively against lax.div in tests/test_mapper_jax.py."""
+    assert w >= 1
+    l = max(0, (int(w) - 1).bit_length())
+    m = -((-(1 << (49 + l))) // int(w))  # ceil division
+    return m, l
 
 
 class _RowLevel:
@@ -324,14 +361,28 @@ class _RowLevel:
         S = A.max_size
         F = 7 if int(BucketAlg.LIST) in algs or int(BucketAlg.STRAW) in algs \
             else 4
+        if int(BucketAlg.STRAW2) in algs:
+            F = _N_RF
         self.F = F
         row = np.zeros((len(reach), F, S), np.int32)
         sca = np.zeros((len(reach), 3), np.int32)
+        magic_memo: dict[int, tuple[int, int]] = {}
         for k, s in enumerate(reach):
             n = int(A.size[s])
             row[k, _RF_ITEM] = A.items[s]
             row[k, _RF_ID] = A.arg_ids[s]
             row[k, _RF_W] = A.pos_weights[0, s].view(np.int32)
+            if F >= _N_RF:
+                for j in range(n):  # only real slots; pads stay w=0
+                    w = int(A.pos_weights[0, s, j])
+                    if w > 0:
+                        if w not in magic_memo:
+                            magic_memo[w] = _magic_div_consts(w)
+                        m, l = magic_memo[w]
+                        row[k, _RF_M0, j] = m & 0xFFFFFF
+                        row[k, _RF_M1, j] = (m >> 24) & 0xFFFFFF
+                        row[k, _RF_M2, j] = m >> 48
+                        row[k, _RF_L, j] = l
             out = np.full(S, _SKIP, np.int32)
             for j in range(n):
                 it = int(A.items[s, j])
@@ -382,13 +433,41 @@ def _prep_levels(A: CrushArrays, start_slots, target_type: int):
 
 
 def _scan_rows(lv: _RowLevel, slot):
-    """Select-scan the level's packed tables by traced slot scalar."""
-    row = jnp.asarray(lv.ROW[0])
-    sca = jnp.asarray(lv.SCA[0])
-    for k, s in enumerate(lv.reach[1:], start=1):
-        m = slot == s
-        row = jnp.where(m, jnp.asarray(lv.ROW[k]), row)
-        sca = jnp.where(m, jnp.asarray(lv.SCA[k]), sca)
+    """Fetch the level's packed tables by traced slot scalar, gather-free.
+
+    Small reach: trace-unrolled select chain (|reach| vector selects of
+    constant rows).  Large reach: one-hot matmul — f32 can hold any 16-bit
+    limb exactly and a one-hot row sum touches exactly one table row, so
+    splitting the i32 tables into two 16-bit limb planes and contracting
+    [G] x [G, F*S*2+3] on the MXU reconstructs the rows bit-exactly while
+    scaling to thousands of buckets (the 10k-OSD map's host level)."""
+    G = len(lv.reach)
+    if G < _REACH_ONEHOT_MIN:
+        row = jnp.asarray(lv.ROW[0])
+        sca = jnp.asarray(lv.SCA[0])
+        for k, s in enumerate(lv.reach[1:], start=1):
+            m = slot == s
+            row = jnp.where(m, jnp.asarray(lv.ROW[k]), row)
+            sca = jnp.where(m, jnp.asarray(lv.SCA[k]), sca)
+        return row, sca
+    if not hasattr(lv, "_OH"):
+        F, S = lv.ROW.shape[1], lv.ROW.shape[2]
+        flat = lv.ROW.reshape(G, F * S)
+        lo = (flat & 0xFFFF).astype(np.float32)
+        hi = (flat >> 16).astype(np.float32)  # arithmetic: signed hi limb
+        lv._OH = np.concatenate(
+            [lo, hi, lv.SCA.astype(np.float32)], axis=1
+        )  # [G, 2*F*S + 3]
+        lv._reach_arr = np.asarray(lv.reach, np.int32)
+    F, S = lv.ROW.shape[1], lv.ROW.shape[2]
+    oh = (slot == jnp.asarray(lv._reach_arr)).astype(jnp.float32)  # [G]
+    got = jnp.matmul(
+        oh, jnp.asarray(lv._OH), precision="highest", preferred_element_type=jnp.float32
+    )  # [2*F*S + 3]
+    lo = got[: F * S].astype(jnp.int32)
+    hi = got[F * S: 2 * F * S].astype(jnp.int32)
+    row = ((hi << 16) | lo).reshape(F, S)
+    sca = got[2 * F * S:].astype(jnp.int32)
     return row, sca
 
 
@@ -402,25 +481,51 @@ def _u32row(row):
     return row.astype(jnp.int64) & 0xFFFFFFFF
 
 
+LN_IMPL: str | None = None  # None=auto; "gather" | "scan" | "onehot"
+
+
 def _ln_fn(u):
-    """crush_ln(u) for u = hash & 0xffff: select-scan on accelerators,
-    64k-table gather on CPU (gathers are cheap there, giant select chains
-    are slow to compile)."""
+    """crush_ln(u) for u = hash & 0xffff: one-hot MXU matmul on
+    accelerators, 64k-table gather on CPU (gathers are cheap there, giant
+    select chains / useless matmuls are slow).  LN_IMPL overrides (tests
+    and the perf probe exercise every form)."""
     import jax as _jax
 
-    if _jax.default_backend() == "cpu":
+    impl = LN_IMPL or (
+        "gather" if _jax.default_backend() == "cpu" else "onehot"
+    )
+    if impl == "gather":
         return jnp.asarray(ln64k_table())[u]
-    return crush_ln_scan_jax(u)
+    if impl == "scan":
+        return crush_ln_scan_jax(u)
+    return crush_ln_onehot_jax(u)
 
 
 def _straw2_rows(row, size, x, r):
-    """Row-table straw2 (same math as _straw2_choose)."""
+    """Row-table straw2 (same math as _straw2_choose, divide-free).
+
+    The C draw is div64_s64(crush_ln(u) - 2^48, w) (reference
+    src/crush/mapper.c:350-358).  With n = 2^48 - crush_ln(u) >= 0 that is
+    exactly -floor(n / w); the truncating divide — an emulated multi-
+    hundred-cycle op on the 32-bit TPU VPU — becomes a 24-bit-limb
+    multiply-high by the per-item constants precomputed in the row tables
+    (_magic_div_consts), bit-exact per the Granlund-Montgomery bound."""
     w = _u32row(row[_RF_W])
     u = (_h3(x, row[_RF_ID], r) & 0xFFFF).astype(jnp.uint32)
-    ln = _ln_fn(u) - jnp.int64(0x1000000000000)
-    draw = lax.div(ln, jnp.maximum(w, 1))
+    n = jnp.int64(1 << 48) - _ln_fn(u)  # in [0, 2^48]
+    n0 = n & 0xFFFFFF
+    n1 = n >> 24
+    m0 = row[_RF_M0].astype(jnp.int64)
+    m1 = row[_RF_M1].astype(jnp.int64)
+    m2 = row[_RF_M2].astype(jnp.int64)
+    t0 = n0 * m0
+    t1 = n0 * m1 + n1 * m0 + (t0 >> 24)
+    t2 = n0 * m2 + n1 * m1 + (t1 >> 24)
+    t3 = n1 * m2 + (t2 >> 24)
+    high = (t2 & 0xFFFFFF) | (t3 << 24)  # floor(n*m / 2^48)
+    q = high >> (row[_RF_L].astype(jnp.int64) + 1)
     mask = jnp.arange(row.shape[-1]) < size
-    draw = jnp.where((w > 0) & mask, draw, S64_MIN)
+    draw = jnp.where((w > 0) & mask, -q, S64_MIN)
     return jnp.argmax(draw)
 
 
@@ -1371,6 +1476,17 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                     _walk_bound(A, _slots_of_type(A, arg2), 0)
                     if leafy and arg2 != 0 else None
                 )
+                # row-path level tables (gather-free unrolled descent); only
+                # used by the fast kernels, and only on accelerator backends
+                # (on CPU the gather fori_loop compiles faster and runs fine)
+                levels = leaf_levels = None
+                if use_fast and _use_row_path():
+                    if src_slots:
+                        levels = _prep_levels(A, src_slots, arg2)
+                    if leafy and arg2 != 0:
+                        leaf_levels = _prep_levels(
+                            A, _slots_of_type(A, arg2), 0
+                        )
 
                 o = jnp.full(RMAX, ITEM_NONE, jnp.int32)
                 osize = jnp.int32(0)
@@ -1391,6 +1507,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                                 weight_max=weight_max, out_bound=NR,
                                 window=numrep + window_extra,
                                 bound=bound, leaf_bound=leaf_bound,
+                                levels=levels, leaf_levels=leaf_levels,
                             )
                             unresolved = unresolved | flg
                         else:
@@ -1416,6 +1533,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                                 recurse_tries=recurse_tries,
                                 weight_max=weight_max, out_bound=NR,
                                 bound=bound, leaf_bound=leaf_bound,
+                                levels=levels, leaf_levels=leaf_levels,
                             )
                         else:
                             vals, leafs, n = _choose_indep_one(

@@ -109,3 +109,56 @@ def test_stable_mod():
             want = lo if lo < b else x & (bmask >> 1)
             assert int(stable_mod(x, b, bmask)) == want
             assert int(stable_mod(x, b, bmask)) < b
+
+
+def test_crush_ln_scan_jax_exhaustive():
+    """crush_ln_scan_jax (the TPU select-scan form) over the full 2^16
+    input domain vs the numpy oracle."""
+    from ceph_tpu.core.lntable import crush_ln_np, crush_ln_scan_jax
+
+    u = np.arange(65536, dtype=np.uint32)
+    want = crush_ln_np(u).astype(np.int64)
+    got = np.asarray(crush_ln_scan_jax(u))
+    assert np.array_equal(want, got)
+
+
+def test_crush_ln_onehot_jax_exhaustive():
+    """crush_ln_onehot_jax (the MXU one-hot-matmul form) over the full
+    2^16 input domain vs the numpy oracle."""
+    from ceph_tpu.core.lntable import crush_ln_np, crush_ln_onehot_jax
+
+    u = np.arange(65536, dtype=np.uint32)
+    want = crush_ln_np(u).astype(np.int64)
+    got = np.asarray(crush_ln_onehot_jax(u))
+    assert np.array_equal(want, got)
+
+
+def test_straw2_magic_division():
+    """The row path's invariant-divisor multiply (mapper_jax._magic_div_consts
+    + the 24-bit-limb multiply-high in _straw2_rows) equals floor division
+    for every weight class and the full numerator range boundary cases."""
+    from ceph_tpu.crush.mapper_jax import _magic_div_consts
+
+    rng = np.random.default_rng(1234)
+    ws = np.concatenate([
+        np.arange(1, 512),
+        (2 ** np.arange(0, 32, dtype=np.int64)),
+        (2 ** np.arange(1, 32, dtype=np.int64)) - 1,
+        (2 ** np.arange(1, 32, dtype=np.int64)) + 1,
+        rng.integers(1, 2**32, 1000),
+    ]).astype(np.int64)
+    ns = np.concatenate([
+        np.array([0, 1, 2, (1 << 48) - 1, 1 << 48]),
+        rng.integers(0, (1 << 48) + 1, 4000),
+    ]).astype(np.int64)
+    for w in ws:
+        m, l = _magic_div_consts(int(w))
+        m0, m1, m2 = m & 0xFFFFFF, (m >> 24) & 0xFFFFFF, m >> 48
+        n0, n1 = ns & 0xFFFFFF, ns >> 24
+        t0 = n0 * m0
+        t1 = n0 * m1 + n1 * m0 + (t0 >> 24)
+        t2 = n0 * m2 + n1 * m1 + (t1 >> 24)
+        t3 = n1 * m2 + (t2 >> 24)
+        high = (t2 & 0xFFFFFF) | (t3 << 24)
+        q = high >> (l + 1)
+        assert np.array_equal(q, ns // w), f"w={w}"

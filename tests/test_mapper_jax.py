@@ -199,3 +199,70 @@ def test_chooseleaf_indep_type0_stale_out2():
     for dead in (2, 5, 6):
         weights[dead] = 0
     compare_jax(m, ruleno, weights, 4, n_x=64)
+
+
+# ---------------------------------------------------------------------------
+# Row-path (gather-free unrolled descent) differential coverage.  The row
+# path only auto-activates on accelerator backends; FORCE_ROW_PATH=True
+# exercises it under the CPU test mesh, against the same host oracle.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["scan", "onehot"])
+def row_path(request):
+    from ceph_tpu.crush import mapper_jax as mj
+
+    mj.FORCE_ROW_PATH = True
+    mj.LN_IMPL = request.param
+    yield request.param
+    mj.FORCE_ROW_PATH = None
+    mj.LN_IMPL = None
+
+
+def test_rowpath_chooseleaf_three_level(rng, row_path):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3, n_rack=4,
+                         weight_fn=lambda i: 0x10000 + (i % 7) * 0x3000)
+    r = m.make_replicated_rule(root, HOST)
+    w = [0x10000] * 24
+    w[3] = 0
+    w[10] = 0x8000
+    compare_jax(m, r, w, 3)
+
+
+def test_rowpath_choose_then_chooseleaf(rng, row_path):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3, n_rack=4)
+    m.add_rule(Rule([(RuleOp.TAKE, root, 0),
+                     (RuleOp.CHOOSE_FIRSTN, 2, RACK),
+                     (RuleOp.CHOOSELEAF_FIRSTN, 2, HOST),
+                     (RuleOp.EMIT, 0, 0)]))
+    compare_jax(m, 0, [0x10000] * 24, 4)
+
+
+def test_rowpath_indep_ec(rng, row_path):
+    m, root = build_tree(rng, n_host=8, osd_per_host=3)
+    r = m.make_erasure_rule(root, HOST)
+    w = [0x10000] * 24
+    w[7] = 0
+    compare_jax(m, r, w, 6)
+
+
+def test_rowpath_mixed_algs(rng, row_path):
+    """straw + list hosts take the row form; a tree host forces the
+    per-level gather fallback within the same unrolled descent."""
+    for alg in (BucketAlg.STRAW, BucketAlg.LIST, BucketAlg.TREE):
+        m, root = build_tree(rng, n_host=5, osd_per_host=4, host_alg=alg)
+        r = m.make_replicated_rule(root, HOST)
+        w = [0x10000] * 20
+        w[2] = 0
+        compare_jax(m, r, w, 3, n_x=101)
+
+
+def test_rowpath_onehot_reach(rng, row_path):
+    """Reach sets >= _REACH_ONEHOT_MIN fetch rows via the one-hot matmul;
+    32 hosts crosses the threshold."""
+    m, root = build_tree(rng, n_host=32, osd_per_host=2,
+                         weight_fn=lambda i: 0x10000 + (i % 11) * 0x1000)
+    r = m.make_replicated_rule(root, HOST)
+    w = [0x10000] * 64
+    w[5] = 0
+    w[33] = 0x4000
+    compare_jax(m, r, w, 3, n_x=101)
