@@ -84,10 +84,13 @@ class Stages:
     def put(self, name: str, value) -> None:
         self.data[name] = value
         self.data["stages_done"].append(name)
+        self.flush()
+        _log(f"stage {name} done")
+
+    def flush(self) -> None:
         tmp = self.path.with_suffix(".tmp")
         tmp.write_text(json.dumps(self.data))
         tmp.replace(self.path)
-        _log(f"stage {name} done")
 
     def fail(self, name: str, err: Exception) -> None:
         self.data.setdefault("errors", {})[name] = (
@@ -248,6 +251,58 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
         "chunk": B,
         "hist_checksum": int(hist.sum()) + int(phist.sum()),
     }
+
+
+def bench_rebalance(n_pgs: int, n_osds: int, rounds: int,
+                    remaining, st=None) -> dict:
+    """North-star sim (BASELINE config 5): build an n_pgs/n_osds map,
+    perturb OSD reweights, then run upmap balancer rounds with per-round
+    wall-clock — the reference's `osdmaptool --upmap` loop
+    (src/tools/osdmaptool.cc:490-543 prints per-round "Time elapsed"; each
+    round's calc_pg_upmaps internally re-maps every PG of every pool,
+    src/osd/OSDMap.cc:4634,4652-4665).  Runs on the device-resident
+    balancer backend: membership rows stay in HBM, host holds O(OSDs)."""
+    from ceph_tpu.balancer.upmap import calc_pg_upmaps
+
+    res: dict = {"pgs": n_pgs, "osds": n_osds}
+    t0 = time.perf_counter()
+    m = build_map(n_pgs, n_osds)
+    res["build_s"] = round(time.perf_counter() - t0, 1)
+    # reweight: simulate reweight-by-utilization on 2% of OSDs
+    rng = np.random.default_rng(5)
+    for o in rng.choice(n_osds, max(1, n_osds // 50), replace=False):
+        m.osd_weight[int(o)] = int(0x10000 * 0.85)
+    cache: dict = {}
+    per_round = []
+    res["rounds"] = per_round
+    total_changed = 0
+    for rnd in range(rounds):
+        t0 = time.perf_counter()
+        r = calc_pg_upmaps(
+            m, max_deviation=5, max_iter=10, backend="device",
+            rng=np.random.default_rng(100 + rnd), device_cache=cache,
+        )
+        dt = time.perf_counter() - t0
+        per_round.append({
+            "round": rnd,
+            "wall_s": round(dt, 2),
+            "num_changed": r.num_changed,
+            "stddev": round(float(r.stddev), 1),
+            "max_deviation": round(float(r.max_deviation), 2),
+        })
+        total_changed += r.num_changed
+        res["total_changed"] = total_changed
+        res["upmap_items"] = len(m.pg_upmap_items)
+        if st is not None:  # flush progress: a killed worker keeps rounds
+            st.data["rebalance"] = res
+            st.flush()
+        if r.num_changed == 0:
+            res["converged"] = True
+            break
+        if remaining() < 1.5 * dt + 30:
+            res["truncated_by_deadline"] = True
+            break
+    return res
 
 
 def bench_c_reference(m, n: int) -> float | None:
@@ -412,6 +467,19 @@ def worker() -> None:
     except Exception as e:
         st.fail("headline", e)
 
+    # -- north-star: 10M-PG / 10k-OSD rebalance sim ----------------------
+    ns_pgs = int(os.environ.get("BENCH_NS_PGS", 10_000_000))
+    ns_osds = int(os.environ.get("BENCH_NS_OSDS", 10_000))
+    ns_rounds = int(os.environ.get("BENCH_NS_ROUNDS", 10))
+    if remaining() < 120:
+        st.put("rebalance_skipped", {"remaining_s": round(remaining(), 1)})
+        return
+    try:
+        r = bench_rebalance(ns_pgs, ns_osds, ns_rounds, remaining, st=st)
+        st.put("rebalance", r)
+    except Exception as e:
+        st.fail("rebalance", e)
+
 
 # -------------------------------------------------------------- supervisor
 
@@ -442,6 +510,8 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         "ec": ec,
         "elapsed_s": round(elapsed, 1),
     }
+    if "rebalance" in stages:
+        out["rebalance_10m_10k"] = stages["rebalance"]
     if "headline_skipped" in stages:
         notes = notes + [
             "headline skipped at deadline "

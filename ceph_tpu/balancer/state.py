@@ -1,0 +1,298 @@
+"""Membership-state backends for the upmap balancer's greedy loop.
+
+The reference optimizer (`OSDMap::calc_pg_upmaps`, reference
+src/osd/OSDMap.cc:4634-5208) keeps a `map<osd, set<pg>>` of its OWN
+bookkeeping — it never remaps after a change; membership evolves purely by
+the discard/add pairs the greedy applies.  That bookkeeping is the state
+interface here, with two implementations:
+
+- SetState: dict-of-sets, bit-for-bit the semantics the oracle tests pin
+  (small maps, CI).  Every change attempt copies the whole table, exactly
+  like the reference's `temp_pgs_by_osd`.
+- DeviceState: the 10M-PG/10k-OSD form.  Per-PG membership rows live ON
+  DEVICE (one [pg_num, W] i32 tensor per pool, optionally sharded over a
+  jax Mesh along the PG axis); the host keeps only O(OSDs) count/deviation
+  vectors.  A change attempt is a tiny delta dict; `pgs_of` is a masked
+  nonzero on device fetching only the matching PG indices.  Deviation
+  totals are summed in ascending-osd order (the reference iterates a
+  sorted std::map, src/osd/OSDMap.cc:4707).
+
+Both expose:
+    deviations() -> (dev: {osd: float}, sum_sq: float, max_abs: float)
+    pgs_of(osd)  -> ascending list[PgId] of current members
+    begin() -> txn;  txn.move(pg, frm, to);  txn.deviations();  commit(txn)
+
+`move(pg, a, b)` = the reference's paired
+`temp[a].discard(pg); temp[b].add(pg)`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.types import PgId
+
+
+class SetState:
+    """dict-of-sets bookkeeping (reference-faithful small-scale backend)."""
+
+    def __init__(self, pgs_by_osd: dict[int, set], osd_weight: dict[int, float],
+                 pgs_per_weight: float):
+        self.pbo = {o: s for o, s in pgs_by_osd.items() if o in osd_weight}
+        for o in osd_weight:
+            self.pbo.setdefault(o, set())
+        self.osd_weight = osd_weight
+        self.ppw = pgs_per_weight
+
+    def _dev(self, pbo):
+        # Summation order matters for float ties: both backends sum d^2 in
+        # ascending-osd order via np.sum (the reference iterates a sorted
+        # std::map, src/osd/OSDMap.cc:4707) so accept/reject decisions on
+        # near-tie stddev comparisons cannot diverge between them.
+        dev = {
+            osd: len(pbo.get(osd, ())) - w * self.ppw
+            for osd, w in self.osd_weight.items()
+        }
+        vals = np.asarray([dev[o] for o in sorted(dev)], np.float64)
+        return dev, float(np.sum(vals * vals)), float(
+            np.max(np.abs(vals), initial=0.0)
+        )
+
+    def deviations(self):
+        return self._dev(self.pbo)
+
+    def pgs_of(self, osd):
+        return sorted(self.pbo.get(osd, ()))
+
+    def begin(self):
+        return _SetTxn(self)
+
+    def commit(self, txn: "_SetTxn"):
+        self.pbo = txn.temp
+
+
+class _SetTxn:
+    def __init__(self, st: SetState):
+        self.st = st
+        self.temp = {o: set(s) for o, s in st.pbo.items()}
+
+    def move(self, pg, frm, to):
+        self.temp.setdefault(frm, set()).discard(pg)
+        self.temp.setdefault(to, set()).add(pg)
+
+    def deviations(self):
+        return self.st._dev(self.temp)
+
+
+class DeviceState:
+    """Device-resident membership rows + O(OSDs) host vectors.
+
+    rows[pool] is the balancer's bookkeeping of which OSDs hold each PG
+    (initialized from the batched pipeline's `up` result, evolved by
+    `move` like the reference's set bookkeeping — NOT remapped).  With a
+    mesh, rows shard over the PG axis and every query runs SPMD
+    (ParallelPGMapper's pgid-range shards, reference
+    src/osd/OSDMapMapping.h:18-140, as GSPMD partitions instead of
+    threads)."""
+
+    def __init__(self, m, osd_weight: dict[int, float],
+                 pgs_per_weight: float, only_pools=None, mesh=None,
+                 chunk: int | None = None, cache: dict | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.osd.pipeline_jax import DEFAULT_CHUNK, PoolMapper
+
+        self.jnp = jnp
+        self.jax = jax
+        self.osd_weight = dict(osd_weight)
+        self.ppw = pgs_per_weight
+        self.mesh = mesh
+        self._weight_osds = np.asarray(sorted(self.osd_weight), np.int32)
+        self._weight_vec = np.asarray(
+            [self.osd_weight[o] for o in self._weight_osds], np.float64
+        )
+        self.max_osd = int(m.max_osd)
+        self.rows: dict[int, object] = {}
+        self.pg_num: dict[int, int] = {}
+        chunk = chunk or DEFAULT_CHUNK
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._sharding = NamedSharding(mesh, P(mesh.axis_names[0], None))
+        counts = jnp.zeros(self.max_osd + 1, jnp.int64)
+        for pid in sorted(m.pools):
+            if only_pools and pid not in only_pools:
+                continue
+            # Map WITHOUT overlay tensors (a dense [pg_num] overlay upload
+            # per call defeats the O(OSDs)-host-traffic design); the few
+            # upmap-carrying PGs get exact host-computed rows scattered in
+            # below.  Membership is content-based, so primary reordering
+            # is irrelevant here.  `cache` (caller-owned dict) reuses the
+            # compiled per-pool mapper across successive balancer rounds —
+            # the kernel depends only on crush structure + bucket weights,
+            # both fixed across a rebalance run; the per-OSD in/out/weight
+            # vectors are refreshed from m on every build.
+            if cache is not None and pid in cache:
+                pm = cache[pid]
+                pm.refresh_dev()
+            else:
+                pm = PoolMapper(m, pid, overlays=False)
+                if cache is not None:
+                    cache[pid] = pm
+            n = pm.spec.pg_num
+            rows, nflg, flg_blocks, B = self._map_device(pm, n, chunk)
+            if int(nflg):
+                rows = self._rescue(pm, rows, flg_blocks, B, n)
+            fixups = [
+                pg.seed for pg in
+                list(m.pg_upmap) + list(m.pg_upmap_items)
+                if pg.pool == pid and pg.seed < n
+            ]
+            if fixups:
+                W = rows.shape[1]
+                fix_rows = np.full((len(fixups), W), ITEM_NONE, np.int32)
+                for i, seed in enumerate(fixups):
+                    up, _, _, _ = m.pg_to_up_acting_osds(PgId(pid, seed))
+                    fix_rows[i, : min(len(up), W)] = up[:W]
+                rows = rows.at[jnp.asarray(fixups)].set(
+                    jnp.asarray(fix_rows)
+                )
+            if mesh is not None:
+                npad = -(-n // mesh.devices.size) * mesh.devices.size
+                rows = rows[:min(n, rows.shape[0])]
+                if npad > rows.shape[0]:
+                    rows = jnp.concatenate([
+                        rows,
+                        jnp.full(
+                            (npad - rows.shape[0], rows.shape[1]),
+                            ITEM_NONE, rows.dtype,
+                        ),
+                    ])
+                rows = jax.device_put(rows, self._sharding)
+            self.rows[pid] = rows
+            self.pg_num[pid] = n
+            live = jnp.arange(rows.shape[0]) < n
+            valid = (rows != ITEM_NONE) & (rows >= 0) & live[:, None]
+            idx = jnp.where(valid, jnp.clip(rows, 0, self.max_osd),
+                            self.max_osd)
+            counts = counts.at[idx.reshape(-1)].add(1)
+        self.counts = np.array(counts[: self.max_osd])  # tiny fetch; writable
+        self._pgs_cache: dict[int, list] = {}
+
+    def _map_device(self, pm, n: int, chunk: int):
+        """Block-map the pool with the fast kernel, results staying on
+        device; returns (rows[npad, W], unresolved_total, flag blocks, B)."""
+        import jax
+        import jax.numpy as jnp
+
+        B = min(chunk, n)
+        nb = (n + B - 1) // B
+        vfast = pm.jitted_fast()  # trace cache shared across rounds
+        dev = pm.dev
+        ups, flgs = [], []
+        nflg = jnp.int64(0)
+        for i in range(nb):
+            ps = jnp.asarray(
+                (np.arange(i * B, (i + 1) * B) % n).astype(np.uint32)
+            )
+            up, _, _, _, flg = vfast(ps, dev, {})
+            ups.append(up)
+            flgs.append(flg)
+            nflg = nflg + flg.sum()
+        rows = jnp.concatenate(ups) if len(ups) > 1 else ups[0]
+        self._vfast_dev = dev
+        return rows, nflg, flgs, B
+
+    def _rescue(self, pm, rows, flg_blocks, B: int, n: int):
+        """Exact loop-kernel recompute of fast-window-inconclusive lanes
+        (rare), scattered into the device rows."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.crush.mapper_jax import RESCUE_PAD
+
+        vloop = pm.jitted_loop()
+        for bi, f in enumerate(flg_blocks):
+            fv = np.asarray(f)
+            if not fv.any():
+                continue
+            idx = np.nonzero(fv)[0] + bi * B
+            idx = idx[idx < n]
+            for i in range(0, len(idx), RESCUE_PAD):
+                blk = idx[i:i + RESCUE_PAD]
+                pad = np.resize(blk, RESCUE_PAD)
+                up, _, _, _ = vloop(
+                    jnp.asarray(pad.astype(np.uint32)), self._vfast_dev, {}
+                )
+                rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
+        return rows
+
+    # -- deviations ------------------------------------------------------
+    def _dev_from_counts(self, counts: np.ndarray):
+        # ascending-osd np.sum — identical order/method to SetState._dev
+        d = counts[self._weight_osds].astype(np.float64) \
+            - self._weight_vec * self.ppw
+        dev = {int(o): float(x) for o, x in zip(self._weight_osds, d)}
+        return dev, float(np.sum(d * d)), float(np.max(np.abs(d), initial=0.0))
+
+    def deviations(self):
+        return self._dev_from_counts(self.counts)
+
+    # -- membership query ------------------------------------------------
+    def pgs_of(self, osd):
+        if osd in self._pgs_cache:
+            return list(self._pgs_cache[osd])
+        jnp = self.jnp
+        out: list[PgId] = []
+        total = int(self.counts[osd]) if 0 <= osd < self.max_osd else 0
+        K = max(16, 1 << (total + 8).bit_length())
+        for pid in sorted(self.rows):
+            rows = self.rows[pid]
+            mask = jnp.any(rows == osd, axis=1)
+            mask = mask & (jnp.arange(rows.shape[0]) < self.pg_num[pid])
+            (idx,) = jnp.nonzero(mask, size=K, fill_value=-1)
+            idx = np.asarray(idx)
+            out.extend(PgId(pid, int(s)) for s in idx[idx >= 0])
+        self._pgs_cache[osd] = out
+        return list(out)
+
+    # -- transactions ----------------------------------------------------
+    def begin(self):
+        return _DeviceTxn(self)
+
+    def commit(self, txn: "_DeviceTxn"):
+        jnp = self.jnp
+        for (pid, seed), swaps in txn.ops.items():
+            rows = self.rows[pid]
+            row = rows[seed]
+            for frm, to in swaps:
+                row = jnp.where(row == frm, to, row)
+            self.rows[pid] = rows.at[seed].set(row)
+        for osd, delta in txn.delta.items():
+            if 0 <= osd < self.max_osd:
+                self.counts[osd] += delta
+        touched = set(txn.delta)
+        self._pgs_cache = {
+            o: v for o, v in self._pgs_cache.items() if o not in touched
+        }
+
+
+class _DeviceTxn:
+    def __init__(self, st: DeviceState):
+        self.st = st
+        self.delta: dict[int, int] = {}
+        self.ops: dict[tuple[int, int], list[tuple[int, int]]] = {}
+
+    def move(self, pg, frm, to):
+        self.delta[frm] = self.delta.get(frm, 0) - 1
+        self.delta[to] = self.delta.get(to, 0) + 1
+        self.ops.setdefault((pg.pool, pg.seed), []).append((frm, to))
+
+    def deviations(self):
+        counts = self.st.counts.copy()
+        for osd, d in self.delta.items():
+            if 0 <= osd < self.st.max_osd:
+                counts[osd] += d
+        return self.st._dev_from_counts(counts)

@@ -227,15 +227,13 @@ class UpmapResult:
 
 def _build_pgs_by_osd(
     m: OSDMap, only_pools, use_tpu: bool
-) -> tuple[dict[int, set], int]:
+) -> dict[int, set]:
     """Map every PG of every (selected) pool; the reference's per-PG loop
     (OSDMap.cc:4652-4665) replaced by the batched pipeline."""
     pgs_by_osd: dict[int, set] = {}
-    total_pgs = 0
     for pool_id, pool in sorted(m.pools.items()):
         if only_pools and pool_id not in only_pools:
             continue
-        total_pgs += pool.size * pool.pg_num
         if use_tpu:
             from ceph_tpu.osd.pipeline_jax import PoolMapper
 
@@ -252,7 +250,7 @@ def _build_pgs_by_osd(
                 for osd in up:
                     if osd != ITEM_NONE:
                         pgs_by_osd.setdefault(osd, set()).add(pg)
-    return pgs_by_osd, total_pgs
+    return pgs_by_osd
 
 
 def calc_pg_upmaps(
@@ -264,22 +262,33 @@ def calc_pg_upmaps(
     local_fallback_retries: int = 100,
     use_tpu: bool = True,
     rng: np.random.Generator | None = None,
+    backend: str = "sets",
+    mesh=None,
+    device_cache: dict | None = None,
 ) -> UpmapResult:
     """Greedy upmap optimization; mutates m.pg_upmap_items.  Returns the
-    change set (the reference's pending_inc).  reference OSDMap.cc:4634."""
+    change set (the reference's pending_inc).  reference OSDMap.cc:4634.
+
+    backend: "sets" (reference-faithful dict-of-sets, small maps) or
+    "device" (membership rows on device, O(OSDs) host state — the
+    10M-PG/10k-OSD form; optionally sharded over `mesh`).  Both evolve
+    the same bookkeeping; equivalence is pinned by tests/test_balancer.py.
+    """
+    from ceph_tpu.balancer.state import DeviceState, SetState
+
     res = UpmapResult()
     max_deviation = max(1, max_deviation)
     only_pools = only_pools or set()
     rng = rng or np.random.default_rng(0)
 
-    pgs_by_osd, total_pgs = _build_pgs_by_osd(m, only_pools, use_tpu)
-
     # per-osd weight from the pools' crush rules
+    total_pgs = 0
     osd_weight: dict[int, float] = {}
     osd_weight_total = 0.0
     for pool_id, pool in sorted(m.pools.items()):
         if only_pools and pool_id not in only_pools:
             continue
+        total_pgs += pool.size * pool.pg_num
         ruleno = mapper_ref.find_rule(
             m.crush, pool.crush_rule, int(pool.type), pool.size
         )
@@ -292,30 +301,20 @@ def calc_pg_upmaps(
                 continue
             osd_weight[osd] = osd_weight.get(osd, 0.0) + adjusted
             osd_weight_total += adjusted
-    for osd in osd_weight:
-        pgs_by_osd.setdefault(osd, set())
     if osd_weight_total == 0 or max_iter <= 0:
         return res
     pgs_per_weight = total_pgs / osd_weight_total
 
-    # deviations
-    def deviations(pbo):
-        dev = {}
-        stddev = 0.0
-        maxdev = 0.0
-        for osd, pgs in pbo.items():
-            if osd not in osd_weight:
-                continue
-            target = osd_weight[osd] * pgs_per_weight
-            d = len(pgs) - target
-            dev[osd] = d
-            stddev += d * d
-            maxdev = max(maxdev, abs(d))
-        return dev, stddev, maxdev
+    if backend == "device":
+        st = DeviceState(
+            m, osd_weight, pgs_per_weight, only_pools=only_pools, mesh=mesh,
+            cache=device_cache,
+        )
+    else:
+        pgs_by_osd = _build_pgs_by_osd(m, only_pools, use_tpu)
+        st = SetState(pgs_by_osd, osd_weight, pgs_per_weight)
 
-    # drop pgs for osds outside the weight map (not in any rule tree)
-    pgs_by_osd = {o: s for o, s in pgs_by_osd.items() if o in osd_weight}
-    osd_deviation, stddev, cur_max_deviation = deviations(pgs_by_osd)
+    osd_deviation, stddev, cur_max_deviation = st.deviations()
     res.stddev, res.max_deviation = stddev, cur_max_deviation
     if cur_max_deviation <= max_deviation:
         return res
@@ -358,7 +357,7 @@ def calc_pg_upmaps(
         while True:  # retry: label
             to_unmap: set = set()
             to_upmap: dict = {}
-            temp_pgs_by_osd = {o: set(s) for o, s in pgs_by_osd.items()}
+            txn = st.begin()
             found = False
 
             # ---- overfull pass -------------------------------------------
@@ -369,7 +368,7 @@ def calc_pg_upmaps(
                     if not using_more_overfull and deviation <= max_deviation:
                         break
                     pgs = [
-                        pg for pg in sorted(pgs_by_osd.get(osd, ()))
+                        pg for pg in st.pgs_of(osd)
                         if pg not in to_skip
                     ]
                     if aggressive:
@@ -382,12 +381,7 @@ def calc_pg_upmaps(
                         new_items = []
                         for frm, to in items:
                             if to == osd:
-                                temp_pgs_by_osd.setdefault(
-                                    to, set()
-                                ).discard(pg)
-                                temp_pgs_by_osd.setdefault(
-                                    frm, set()
-                                ).add(pg)
+                                txn.move(pg, to, frm)
                             else:
                                 new_items.append((frm, to))
                         if not new_items:
@@ -435,10 +429,7 @@ def calc_pg_upmaps(
                                 max_dev, pos = d, i2
                         if pos != -1:
                             frm, to = orig[pos], out[pos]
-                            temp_pgs_by_osd.setdefault(
-                                frm, set()
-                            ).discard(pg)
-                            temp_pgs_by_osd.setdefault(to, set()).add(pg)
+                            txn.move(pg, frm, to)
                             new_items.append((frm, to))
                             to_upmap[pg] = new_items
                             found = True
@@ -465,12 +456,7 @@ def calc_pg_upmaps(
                         new_items = []
                         for frm, to in items:
                             if frm == osd:
-                                temp_pgs_by_osd.setdefault(
-                                    to, set()
-                                ).discard(pg)
-                                temp_pgs_by_osd.setdefault(
-                                    frm, set()
-                                ).add(pg)
+                                txn.move(pg, to, frm)
                             else:
                                 new_items.append((frm, to))
                         if not new_items:
@@ -494,9 +480,7 @@ def calc_pg_upmaps(
                 break  # out of retry loop
 
             # ---- test_change ---------------------------------------------
-            temp_dev, new_stddev, cur_max_deviation = deviations(
-                temp_pgs_by_osd
-            )
+            temp_dev, new_stddev, cur_max_deviation = txn.deviations()
             if new_stddev >= stddev:
                 if not aggressive:
                     iter_left = 0
@@ -510,7 +494,7 @@ def calc_pg_upmaps(
                 continue  # goto retry
 
             stddev = new_stddev
-            pgs_by_osd = temp_pgs_by_osd
+            st.commit(txn)
             osd_deviation = temp_dev
             for pg in to_unmap:
                 del m.pg_upmap_items[pg]

@@ -408,8 +408,18 @@ class PoolMapper:
             self.arrays, self.spec, path=path, with_flag=True,
             **self._pipe_kw,
         )
-        dv = m.frozen_vectors()
-        DV = max(self.arrays.max_devices, m.max_osd, 1)
+        self.refresh_dev()
+        self._jitted = None
+        self._jloop = None
+        self.chunk = chunk
+
+    def refresh_dev(self) -> None:
+        """(Re)build the padded per-OSD vectors from the map's current
+        osd state/weight/affinity — cheap O(OSDs) work, so callers that
+        reuse a compiled PoolMapper across weight changes (the balancer's
+        round cache) can refresh instead of recompiling."""
+        dv = self.m.frozen_vectors()
+        DV = max(self.arrays.max_devices, self.m.max_osd, 1)
         self.dev = {
             "exists": _pad_to(dv["exists"], DV, False),
             "up": _pad_to(dv["up"], DV, False),
@@ -418,9 +428,22 @@ class PoolMapper:
                 dv["primary_affinity"], DV, DEFAULT_PRIMARY_AFFINITY
             ),
         }
-        self._jitted = None
-        self._jloop = None
-        self.chunk = chunk
+
+    def jitted_fast(self):
+        """The jitted vmapped fast pipeline (with unresolved flag); one
+        trace cache shared by map_batch and external batch drivers."""
+        if self._jitted is None:
+            self._jitted = jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0)))
+        return self._jitted
+
+    def jitted_loop(self):
+        """The jitted vmapped exact loop pipeline (rescue kernel)."""
+        if self._jloop is None:
+            loop_fn = compile_pipeline(
+                self.arrays, self.spec, path="loop", **self._pipe_kw
+            )
+            self._jloop = jax.jit(jax.vmap(loop_fn, in_axes=(0, None, 0)))
+        return self._jloop
 
     def _ov_rows(self, ps: np.ndarray) -> dict:
         ov, rows = self.ov, {}
@@ -460,25 +483,19 @@ class PoolMapper:
         return self._map_block(ps)
 
     def _map_block(self, ps: np.ndarray):
-        if self._jitted is None:
-            self._jitted = jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0)))
-        *out, flg = self._jitted(
+        *out, flg = self.jitted_fast()(
             jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
         )
         flg = np.asarray(flg)
         if flg.any():
-            if self._jloop is None:
-                loop_fn = compile_pipeline(
-                    self.arrays, self.spec, path="loop", **self._pipe_kw
-                )
-                self._jloop = jax.jit(jax.vmap(loop_fn, in_axes=(0, None, 0)))
+            jloop = self.jitted_loop()
             out = [np.array(o) for o in out]  # writable copies
             idx = np.nonzero(flg)[0]
             P = RESCUE_PAD
             for i in range(0, len(idx), P):
                 blk = idx[i:i + P]
                 pad = np.resize(blk, P)  # cycle-pad: one compile per shape
-                sub = self._jloop(
+                sub = jloop(
                     jnp.asarray(ps[pad], np.uint32), self.dev,
                     self._ov_rows(ps[pad]),
                 )
