@@ -143,9 +143,7 @@ class DeviceState:
                 if cache is not None:
                     cache[pid] = pm
             n = pm.spec.pg_num
-            rows, nflg, flg_blocks, B = self._map_device(pm, n, chunk)
-            if int(nflg):
-                rows = self._rescue(pm, rows, flg_blocks, B, n)
+            rows = pm.map_all_device(chunk)
             fixups = [
                 pg.seed for pg in
                 list(m.pg_upmap) + list(m.pg_upmap_items)
@@ -181,53 +179,6 @@ class DeviceState:
             counts = counts.at[idx.reshape(-1)].add(1)
         self.counts = np.array(counts[: self.max_osd])  # tiny fetch; writable
         self._pgs_cache: dict[int, list] = {}
-
-    def _map_device(self, pm, n: int, chunk: int):
-        """Block-map the pool with the fast kernel, results staying on
-        device; returns (rows[npad, W], unresolved_total, flag blocks, B)."""
-        import jax
-        import jax.numpy as jnp
-
-        B = min(chunk, n)
-        nb = (n + B - 1) // B
-        vfast = pm.jitted_fast()  # trace cache shared across rounds
-        dev = pm.dev
-        ups, flgs = [], []
-        nflg = jnp.int64(0)
-        for i in range(nb):
-            ps = jnp.asarray(
-                (np.arange(i * B, (i + 1) * B) % n).astype(np.uint32)
-            )
-            up, _, _, _, flg = vfast(ps, dev, {})
-            ups.append(up)
-            flgs.append(flg)
-            nflg = nflg + flg.sum()
-        rows = jnp.concatenate(ups) if len(ups) > 1 else ups[0]
-        self._vfast_dev = dev
-        return rows, nflg, flgs, B
-
-    def _rescue(self, pm, rows, flg_blocks, B: int, n: int):
-        """Exact loop-kernel recompute of fast-window-inconclusive lanes
-        (rare), scattered into the device rows."""
-        import jax.numpy as jnp
-
-        from ceph_tpu.crush.mapper_jax import RESCUE_PAD
-
-        vloop = pm.jitted_loop()
-        for bi, f in enumerate(flg_blocks):
-            fv = np.asarray(f)
-            if not fv.any():
-                continue
-            idx = np.nonzero(fv)[0] + bi * B
-            idx = idx[idx < n]
-            for i in range(0, len(idx), RESCUE_PAD):
-                blk = idx[i:i + RESCUE_PAD]
-                pad = np.resize(blk, RESCUE_PAD)
-                up, _, _, _ = vloop(
-                    jnp.asarray(pad.astype(np.uint32)), self._vfast_dev, {}
-                )
-                rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
-        return rows
 
     # -- deviations ------------------------------------------------------
     def _dev_from_counts(self, counts: np.ndarray):

@@ -100,6 +100,9 @@ class ClayCode(ErasureCode):
             )
         self.mds_C = maker(k + self.nu, m)
         self.pft = _PairTransform()
+        from ceph_tpu.ec.rs import get_engine
+
+        self.engine = get_engine(profile.get("backend", "numpy"))
 
     def get_sub_chunk_count(self) -> int:
         return self.sub_chunk_no
@@ -429,6 +432,17 @@ class ClayCode(ErasureCode):
             if j != i and j not in helper_chunks
         }
 
+        if not aloof:
+            # the d = #helpers = k+m-1 case (and any no-aloof repair):
+            # every plane has the same score, all deps vanish, and the
+            # whole repair batches over the plane axis — one fused GF
+            # matmul per (node, case) instead of per (node, plane)
+            return {
+                i: self._repair_batched(
+                    lost, helpers, sc, repair_planes, plane_pos
+                ).reshape(-1)
+            }
+
         recovered = np.zeros((self.sub_chunk_no, sc), np.uint8)
         U = {
             n: np.zeros((self.sub_chunk_no, sc), np.uint8)
@@ -502,6 +516,124 @@ class ClayCode(ErasureCode):
                         (rec,) = self.pft.recover(known, [c_sw])
                         recovered[z_sw] = rec
         return {i: recovered.reshape(-1)}
+
+    def _repair_batched(
+        self,
+        lost: int,
+        helpers: dict[int, np.ndarray],
+        sc: int,
+        repair_planes: list[int],
+        plane_pos: dict[int, int],
+    ) -> np.ndarray:
+        """Plane-batched single-chunk repair for the no-aloof case.
+
+        Same math as the per-plane loop in `repair` (reference
+        repair_one_lost_chunk, src/erasure-code/clay/ErasureCodeClay.cc:
+        462-640), restructured so the plane axis is a batch dimension:
+        per live node the pair decoupling becomes ONE GF matmul over the
+        [planes*sc] byte axis (split by the <x / >x index-swap cases),
+        the inner MDS is one matmul over all planes, and the final
+        coupled recovery is one matmul per erased column node.  The
+        partner plane/node indices are precomputed index vectors — the
+        'plane gather/scatter via precomputed index tensors' form that
+        batches onto the engine instead of looping Python per plane."""
+        q, t = self.q, self.t
+        P = len(repair_planes)
+        x_lost, y_lost = lost % q, lost // q
+        zvs = np.array(
+            [self._z_vec(z) for z in repair_planes], np.int64
+        )  # [P, t]
+        n = q * t
+        erasures = {y_lost * q + x for x in range(q)}
+        U = np.zeros((n, P, sc), np.uint8)
+
+        # phase 1: uncoupled symbols of live nodes, batched per (x, y)
+        for y in range(t):
+            if y == y_lost:
+                continue  # whole lost column is erased; no live nodes here
+            for x in range(q):
+                node_xy = y * q + x
+                hx = helpers[node_xy]  # [P, sc] in repair_planes order
+                zy = zvs[:, y]  # partner digit per plane
+                # partner plane position: digit y of z flipped to x; since
+                # y != y_lost the partner plane is itself a repair plane
+                pos_sw = np.array(
+                    [
+                        plane_pos[
+                            z + (x - int(zy[j])) * _pow_int(q, t - 1 - y)
+                        ]
+                        for j, z in enumerate(repair_planes)
+                    ]
+                )
+                eq = zy == x
+                U[node_xy][eq] = hx[eq]
+                for swap, sel in (
+                    (False, (~eq) & (zy < x)),
+                    (True, (~eq) & (zy > x)),
+                ):
+                    if not sel.any():
+                        continue
+                    node_sw = y * q + zy[sel]  # [S] partner node per plane
+                    c_here = hx[sel]  # own coupled
+                    c_part = np.stack(
+                        [
+                            helpers[int(ns)][int(pp)]
+                            for ns, pp in zip(node_sw, pos_sw[sel])
+                        ]
+                    )
+                    # canonical 4-tuple positions (larger-x first): when
+                    # zy > x our node holds position 1, partner 0
+                    known = (
+                        {0: c_part, 1: c_here} if swap
+                        else {0: c_here, 1: c_part}
+                    )
+                    want_u = 3 if swap else 2
+                    R = matrices.recover_matrix(
+                        self.pft.C, [0, 1], [want_u]
+                    )
+                    stack = np.stack([known[0], known[1]])
+                    rec = self.engine.matmul(
+                        R, stack.reshape(2, -1)
+                    ).reshape(-1, sc)
+                    U[node_xy][sel] = rec
+
+        # phase 2: inner MDS across every plane at once
+        present = sorted(set(range(n)) - erasures)[: self.k + self.nu]
+        missing = sorted(erasures)
+        R = matrices.recover_matrix(self.mds_C, present, missing)
+        stack = U[present].reshape(len(present), -1)
+        out = self.engine.matmul(R, stack).reshape(len(missing), P, sc)
+        U[missing] = np.asarray(out)
+
+        # phase 3: coupled symbols of the lost column
+        recovered = np.zeros((self.sub_chunk_no, sc), np.uint8)
+        for nd in missing:
+            x = nd % q
+            if x == x_lost:
+                # hole-dot planes: uncoupled == coupled
+                recovered[np.asarray(repair_planes)] = U[nd]
+                continue
+            # partner is the lost node; writes land on its z_sw planes
+            z_sw = np.array(
+                [
+                    z + (x - x_lost) * _pow_int(q, t - 1 - y_lost)
+                    for z in repair_planes
+                ]
+            )
+            c_xy, c_sw, u_xy, u_sw = self._pair_indices(x, x_lost)
+            known_pos = sorted((c_xy, u_xy))
+            R = matrices.recover_matrix(self.pft.C, known_pos, [c_sw])
+            stack = np.stack(
+                [
+                    helpers[nd] if p == c_xy else U[nd]
+                    for p in known_pos
+                ]
+            )
+            rec = self.engine.matmul(
+                R, stack.reshape(2, -1)
+            ).reshape(-1, sc)
+            recovered[z_sw] = rec
+        return recovered
 
     def decode(
         self,

@@ -18,6 +18,13 @@ from ceph_tpu.ec.gf import gf_matvec_data
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
 
 
+def _is_device_array(x) -> bool:
+    """True for jax device arrays; lists/bytes/numpy are host inputs (the
+    plugin API coerces those with np.asarray).  Module-name check keeps
+    the jax import lazy for jax-free entry points."""
+    return type(x).__module__.split(".")[0] in ("jax", "jaxlib")
+
+
 class NumpyEngine:
     """Host GF matmul engine (table-driven)."""
 
@@ -112,8 +119,14 @@ class RSErasureCode(ErasureCode):
 
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
         assert data.shape[0] == self.k
+        if _is_device_array(data):
+            import jax.numpy as jnp  # device stripes stay on device
+
+            parity = self.engine.matmul(self.C, data)
+            return jnp.concatenate([data, parity], axis=0)
+        data = np.asarray(data, np.uint8)
         parity = self.engine.matmul(self.C, data)
-        return np.concatenate([data, parity], axis=0)
+        return np.concatenate([data, np.asarray(parity)], axis=0)
 
     def decode_chunks(
         self,
@@ -128,7 +141,12 @@ class RSErasureCode(ErasureCode):
             )
         use = present[: self.k]
         missing = sorted(set(want_to_read) - set(chunks))
-        stack = np.stack([np.asarray(chunks[i], np.uint8) for i in use])
+        if any(_is_device_array(chunks[i]) for i in use):
+            import jax.numpy as jnp
+
+            stack = jnp.stack([chunks[i] for i in use])
+        else:
+            stack = np.stack([np.asarray(chunks[i], np.uint8) for i in use])
         out = dict(chunks)
         if missing:
             R = matrices.recover_matrix(self.C, use, missing)

@@ -507,6 +507,52 @@ class PoolMapper:
     def map_all(self):
         return self.map_batch(np.arange(self.spec.pg_num, dtype=np.uint32))
 
+    def map_all_device(self, chunk: int | None = None):
+        """Map every PG of the pool block-wise with results STAYING on
+        device: returns `up` rows [pg_num, W] as a jax array.  Fast-window
+        inconclusive lanes are recomputed through the exact loop kernel
+        and scattered in (same rescue contract as map_batch, without the
+        O(PGs) host transfer).  Overlay tensors are not supported here —
+        callers correct overlay-carrying PGs themselves (see
+        balancer.state.DeviceState)."""
+        assert not (
+            self._pipe_kw["with_upmap_full"]
+            or self._pipe_kw["n_upmap_pairs"]
+            or self._pipe_kw["with_temp"]
+            or self._pipe_kw["with_primary_temp"]
+        ), "map_all_device is an overlay-free path"
+        n = self.spec.pg_num
+        B = min(chunk or self.chunk or DEFAULT_CHUNK, n)
+        nb = (n + B - 1) // B
+        vfast = self.jitted_fast()
+        ups, flgs = [], []
+        nflg = jnp.int64(0)
+        for i in range(nb):
+            ps = jnp.asarray(
+                (np.arange(i * B, (i + 1) * B) % n).astype(np.uint32)
+            )
+            up, _, _, _, flg = vfast(ps, self.dev, {})
+            ups.append(up)
+            flgs.append(flg)
+            nflg = nflg + flg.sum()
+        rows = (jnp.concatenate(ups) if len(ups) > 1 else ups[0])[:n]
+        if int(nflg):
+            vloop = self.jitted_loop()
+            for bi, f in enumerate(flgs):
+                fv = np.asarray(f)
+                if not fv.any():
+                    continue
+                idx = np.nonzero(fv)[0] + bi * B
+                idx = idx[idx < n]
+                for i in range(0, len(idx), RESCUE_PAD):
+                    blk = idx[i:i + RESCUE_PAD]
+                    pad = np.resize(blk, RESCUE_PAD)  # fixed shape
+                    up, _, _, _ = vloop(
+                        jnp.asarray(pad.astype(np.uint32)), self.dev, {}
+                    )
+                    rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
+        return rows
+
 
 def map_cluster(m: OSDMap) -> dict[int, tuple]:
     """Map every pool; returns {pool_id: (up, up_primary, acting,
