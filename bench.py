@@ -51,6 +51,8 @@ from pathlib import Path
 
 import numpy as np
 
+from ceph_tpu import obs
+
 _HERE = Path(__file__).resolve().parent
 sys.path.insert(0, str(_HERE / "tests"))
 
@@ -75,19 +77,37 @@ def _log(msg: str) -> None:
 # ----------------------------------------------------------------- worker
 
 class Stages:
-    """Accumulates stage results; atomically rewrites PARTIAL per flush."""
+    """Accumulates stage results; atomically rewrites PARTIAL per flush.
+
+    Every flush embeds the perf registry (latest snapshot top-level, a
+    per-stage snapshot inside each stage record) and rewrites the
+    CEPH_TPU_TRACE file, so a deadline-killed or hung run leaves a full
+    diagnostic record — which counters advanced, where compile seconds
+    went, how many lanes were unresolved — not a one-line note."""
 
     def __init__(self, path: Path):
         self.path = path
         self.data: dict = {"stages_done": []}
 
     def put(self, name: str, value) -> None:
+        if isinstance(value, dict):
+            value = dict(value, perf=obs.perf_dump())
         self.data[name] = value
         self.data["stages_done"].append(name)
         self.flush()
         _log(f"stage {name} done")
 
     def flush(self) -> None:
+        self.data["perf"] = obs.perf_dump()
+        try:
+            # SIGKILL survival: last flush before a kill wins
+            tp = obs.flush()
+            if tp:
+                self.data["trace"] = tp
+        except OSError as e:
+            # a bad CEPH_TPU_TRACE path must not kill the bench (or mask
+            # the stage error that routed through fail() -> flush())
+            self.data["trace_error"] = f"{type(e).__name__}: {e}"[:200]
         tmp = self.path.with_suffix(".tmp")
         tmp.write_text(json.dumps(self.data))
         tmp.replace(self.path)
@@ -96,9 +116,7 @@ class Stages:
         self.data.setdefault("errors", {})[name] = (
             f"{type(err).__name__}: {err}"[:300]
         )
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(self.data))
-        tmp.replace(self.path)
+        self.flush()
         _log(f"stage {name} FAILED: {type(err).__name__}: {str(err)[:200]}")
 
 
@@ -187,6 +205,13 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
         phist = _hist(actp[:, None], DV, mask[:, None])
         return hist, phist
 
+    # compile/dispatch split into the pipeline perf group: the 24.7s cold
+    # compiles of r05 become pipeline.bench_stats_compile_seconds in every
+    # BENCH_partial.json stage instead of hiding in the headline number
+    pl = obs.logger_for("pipeline")
+    stats_block = obs.JitAccount(stats_block, pl, "bench_stats")
+    rescue_block = obs.JitAccount(rescue_block, pl, "bench_rescue")
+
     @jax.jit
     def accum(h, p, n, dh, dp, dn):
         return h + dh, p + dp, n + dn
@@ -204,40 +229,51 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
         nflg = jnp.int64(0)
         flags = []
         for b in blocks:
-            dh, dp, f, nf = stats_block(b, dev)
-            h, p, nflg = accum(h, p, nflg, dh, dp, nf)
+            with obs.span("pipeline.map_block", pgs=B, bench=True):
+                dh, dp, f, nf = stats_block(b, dev)
+                h, p, nflg = accum(h, p, nflg, dh, dp, nf)
             flags.append(f)
         unresolved = int(nflg)  # forces the whole chain
+        pl.inc("pgs_mapped", n_pgs)  # not nb*B: pad lanes are not real PGs
         if unresolved:
+            pl.inc("rescue_invocations")
             # exact recompute of flagged lanes through the loop kernel,
             # merged into the histograms (cycle-padded fixed-size batches)
-            for bi, f in enumerate(flags):
-                fv = np.asarray(f)
-                if not fv.any():
-                    continue
-                idx = np.nonzero(fv)[0]
-                xs = np.asarray(
-                    (np.arange(bi * B, (bi + 1) * B) % n_pgs)[idx],
-                    np.uint32,
-                )
-                for i in range(0, len(xs), RESCUE_PAD):
-                    blk = xs[i:i + RESCUE_PAD]
-                    pad = np.resize(blk, RESCUE_PAD)  # fixed shape: 1 compile
-                    mask = np.zeros(RESCUE_PAD, bool)
-                    mask[: len(blk)] = True
-                    dh, dp = rescue_block(
-                        jnp.asarray(pad), dev, jnp.asarray(mask)
+            with obs.span("pipeline.rescue", lanes=unresolved, bench=True):
+                for bi, f in enumerate(flags):
+                    fv = np.asarray(f)
+                    if not fv.any():
+                        continue
+                    idx = np.nonzero(fv)[0]
+                    # pad lanes (global index >= n_pgs) are duplicate
+                    # seeds, not real unresolved PGs
+                    pl.inc("unresolved_pgs", int((idx + bi * B < n_pgs).sum()))
+                    xs = np.asarray(
+                        (np.arange(bi * B, (bi + 1) * B) % n_pgs)[idx],
+                        np.uint32,
                     )
-                    h, p = h + dh, p + dp
-        hist = np.asarray(h)  # tiny fetch; forces completion
-        return hist, np.asarray(p), unresolved
+                    for i in range(0, len(xs), RESCUE_PAD):
+                        blk = xs[i:i + RESCUE_PAD]
+                        # fixed shape: 1 compile
+                        pad = np.resize(blk, RESCUE_PAD)
+                        mask = np.zeros(RESCUE_PAD, bool)
+                        mask[: len(blk)] = True
+                        dh, dp = rescue_block(
+                            jnp.asarray(pad), dev, jnp.asarray(mask)
+                        )
+                        h, p = h + dh, p + dp
+        with obs.span("pipeline.fetch", bench=True):
+            hist = np.asarray(h)  # tiny fetch; forces completion
+            return hist, np.asarray(p), unresolved
 
     t0 = time.perf_counter()
-    hist, phist, unresolved = one_pass()
+    with obs.span("bench.cold_pass", pgs=nb * B):
+        hist, phist, unresolved = one_pass()
     cold_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(reps):
-        hist, phist, unresolved = one_pass()
+        with obs.span("bench.warm_pass", pgs=nb * B):
+            hist, phist, unresolved = one_pass()
     dt = (time.perf_counter() - t0) / reps
     mapped = nb * B
     return {
@@ -528,15 +564,23 @@ def worker() -> None:
 
 # -------------------------------------------------------------- supervisor
 
+def _strip_perf(stage):
+    """Per-stage perf snapshots stay in BENCH_partial.json; the headline
+    JSON keeps just the numbers."""
+    if isinstance(stage, dict):
+        return {k: v for k, v in stage.items() if k != "perf"}
+    return stage
+
+
 def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
     configs = {}
     for key in ("crushtool_1k_32", "testmappgs_100k_1k", "headline"):
         if key in stages:
-            configs[key] = stages[key]
+            configs[key] = _strip_perf(stages[key])
     ec = {}
     for key in ("ec_jax", "ec_native", "ec_clay"):
         if key in stages:
-            ec.update(stages[key])
+            ec.update(_strip_perf(stages[key]))
     init = stages.get("init", {})
     head = (configs.get("headline") or configs.get("testmappgs_100k_1k")
             or configs.get("crushtool_1k_32") or {})
@@ -556,7 +600,7 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         "elapsed_s": round(elapsed, 1),
     }
     if "rebalance" in stages:
-        rb = stages["rebalance"]
+        rb = _strip_perf(stages["rebalance"])
         key = "rebalance"
         if rb.get("pgs") == 10_000_000 and rb.get("osds") == 10_000:
             key = "rebalance_10m_10k"  # the BASELINE config-5 name
@@ -624,6 +668,9 @@ def _run_worker(env: dict, deadline: float,
 
 
 def supervise() -> None:
+    from ceph_tpu.obs import admin_socket
+
+    admin_socket.release()  # the worker owns CEPH_TPU_ADMIN_SOCKET
     t0 = time.time()
     notes: list[str] = []
     PARTIAL.unlink(missing_ok=True)

@@ -30,8 +30,14 @@ from __future__ import annotations
 
 import numpy as np
 
+from ceph_tpu import obs
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd.types import PgId
+
+_L = obs.logger_for("balancer")
+_L.add_u64("pgs_of_queries", "device membership queries (masked nonzero)")
+_L.add_time_avg("pgs_of_seconds", "device membership query wall time")
+_L.add_u64("txn_commits", "membership transactions committed")
 
 
 class SetState:
@@ -69,6 +75,7 @@ class SetState:
         return _SetTxn(self)
 
     def commit(self, txn: "_SetTxn"):
+        _L.inc("txn_commits")
         self.pbo = txn.temp
 
 
@@ -143,7 +150,8 @@ class DeviceState:
                 if cache is not None:
                     cache[pid] = pm
             n = pm.spec.pg_num
-            rows = pm.map_all_device(chunk)
+            with obs.span("balancer.map_pool", pool=pid, pgs=n):
+                rows = pm.map_all_device(chunk)
             fixups = [
                 pg.seed for pg in
                 list(m.pg_upmap) + list(m.pg_upmap_items)
@@ -199,13 +207,15 @@ class DeviceState:
         out: list[PgId] = []
         total = int(self.counts[osd]) if 0 <= osd < self.max_osd else 0
         K = max(16, 1 << (total + 8).bit_length())
-        for pid in sorted(self.rows):
-            rows = self.rows[pid]
-            mask = jnp.any(rows == osd, axis=1)
-            mask = mask & (jnp.arange(rows.shape[0]) < self.pg_num[pid])
-            (idx,) = jnp.nonzero(mask, size=K, fill_value=-1)
-            idx = np.asarray(idx)
-            out.extend(PgId(pid, int(s)) for s in idx[idx >= 0])
+        _L.inc("pgs_of_queries")
+        with obs.span("balancer.pgs_of", osd=osd), _L.time("pgs_of_seconds"):
+            for pid in sorted(self.rows):
+                rows = self.rows[pid]
+                mask = jnp.any(rows == osd, axis=1)
+                mask = mask & (jnp.arange(rows.shape[0]) < self.pg_num[pid])
+                (idx,) = jnp.nonzero(mask, size=K, fill_value=-1)
+                idx = np.asarray(idx)
+                out.extend(PgId(pid, int(s)) for s in idx[idx >= 0])
         self._pgs_cache[osd] = out
         return list(out)
 
@@ -214,6 +224,7 @@ class DeviceState:
         return _DeviceTxn(self)
 
     def commit(self, txn: "_DeviceTxn"):
+        _L.inc("txn_commits")
         jnp = self.jnp
         for (pid, seed), swaps in txn.ops.items():
             rows = self.rows[pid]

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ceph_tpu import obs
 from ceph_tpu.balancer.crush_analysis import (
     get_parent_of_type,
     get_rule_weight_osd_map,
@@ -216,6 +217,16 @@ def try_pg_upmap(
 
 # -- calc_pg_upmaps ---------------------------------------------------------
 
+_L = obs.logger_for("balancer")
+_L.add_u64("rounds", "greedy optimizer outer iterations")
+_L.add_u64("changes_accepted", "upmap-item changes committed")
+_L.add_u64("changes_rejected", "upmap-item changes rolled back (stddev up)")
+_L.add_avg("stddev", "PG-count deviation stddev after each accepted change")
+_L.add_avg("max_deviation", "max abs deviation after each accepted change")
+_L.add_time_avg("round_seconds", "wall time per optimizer round")
+_L.add_time_avg("build_state_seconds", "O(PGs) membership-state build time")
+
+
 @dataclass
 class UpmapResult:
     num_changed: int = 0
@@ -305,14 +316,17 @@ def calc_pg_upmaps(
         return res
     pgs_per_weight = total_pgs / osd_weight_total
 
-    if backend == "device":
-        st = DeviceState(
-            m, osd_weight, pgs_per_weight, only_pools=only_pools, mesh=mesh,
-            cache=device_cache,
-        )
-    else:
-        pgs_by_osd = _build_pgs_by_osd(m, only_pools, use_tpu)
-        st = SetState(pgs_by_osd, osd_weight, pgs_per_weight)
+    with obs.span(
+        "balancer.build_state", backend=backend, pgs=total_pgs
+    ), _L.time("build_state_seconds"):
+        if backend == "device":
+            st = DeviceState(
+                m, osd_weight, pgs_per_weight, only_pools=only_pools,
+                mesh=mesh, cache=device_cache,
+            )
+        else:
+            pgs_by_osd = _build_pgs_by_osd(m, only_pools, use_tpu)
+            st = SetState(pgs_by_osd, osd_weight, pgs_per_weight)
 
     osd_deviation, stddev, cur_max_deviation = st.deviations()
     res.stddev, res.max_deviation = stddev, cur_max_deviation
@@ -323,191 +337,204 @@ def calc_pg_upmaps(
     iter_left = max_iter
     while iter_left > 0:
         iter_left -= 1
-        by_dev = sorted(
-            osd_deviation.items(), key=lambda kv: (kv[1], kv[0])
-        )
-        overfull: set[int] = set()
-        more_overfull: set[int] = set()
-        underfull: list[int] = []
-        more_underfull: list[int] = []
-        for osd, d in reversed(by_dev):
-            if d <= 0:
-                break
-            if d > max_deviation:
-                overfull.add(osd)
-            else:
-                more_overfull.add(osd)
-        for osd, d in by_dev:
-            if d >= 0:
-                break
-            if d < -max_deviation:
-                underfull.append(osd)
-            else:
-                more_underfull.append(osd)
-        if not underfull and not overfull:
-            break
-        using_more_overfull = False
-        if not overfull and underfull:
-            overfull = more_overfull
-            using_more_overfull = True
-
-        to_skip: set = set()
-        local_fallback_retried = 0
-
-        while True:  # retry: label
-            to_unmap: set = set()
-            to_upmap: dict = {}
-            txn = st.begin()
-            found = False
-
-            # ---- overfull pass -------------------------------------------
-            if not (skip_overfull and underfull):
-                for osd, deviation in reversed(by_dev):
-                    if deviation < 0:
-                        break
-                    if not using_more_overfull and deviation <= max_deviation:
-                        break
-                    pgs = [
-                        pg for pg in st.pgs_of(osd)
-                        if pg not in to_skip
-                    ]
-                    if aggressive:
-                        rng.shuffle(pgs)  # equal (in)attention
-                    # 1) drop existing remaps INTO this overfull osd
-                    for pg in pgs:
-                        items = m.pg_upmap_items.get(pg)
-                        if items is None:
-                            continue
-                        new_items = []
-                        for frm, to in items:
-                            if to == osd:
-                                txn.move(pg, to, frm)
-                            else:
-                                new_items.append((frm, to))
-                        if not new_items:
-                            to_unmap.add(pg)
-                            found = True
-                            break
-                        elif len(new_items) != len(items):
-                            to_upmap[pg] = new_items
-                            found = True
-                            break
-                    if found:
-                        break
-                    # 2) add a new remapping pair
-                    for pg in pgs:
-                        if pg in m.pg_upmap:
-                            continue
-                        pool = m.get_pg_pool(pg.pool)
-                        new_items = list(m.pg_upmap_items.get(pg, []))
-                        if len(new_items) >= pool.size:
-                            continue
-                        existing: set[int] = set()
-                        for frm, to in new_items:
-                            existing.add(frm)
-                            existing.add(to)
-                        # raw mapping including existing upmaps
-                        raw, _ = m._pg_to_raw_osds(pool, pg)
-                        orig = list(raw)
-                        m._apply_upmap(pool, pg, orig)
-                        out = try_pg_upmap(
-                            m, pg, overfull, underfull, more_underfull, orig
-                        )
-                        if out is None or len(out) != len(orig):
-                            continue
-                        pos, max_dev = -1, 0.0
-                        for i2 in range(len(out)):
-                            if orig[i2] == out[i2]:
-                                continue
-                            if (
-                                orig[i2] in existing
-                                or out[i2] in existing
-                            ):
-                                continue
-                            d = osd_deviation.get(orig[i2], 0.0)
-                            if d > max_dev:
-                                max_dev, pos = d, i2
-                        if pos != -1:
-                            frm, to = orig[pos], out[pos]
-                            txn.move(pg, frm, to)
-                            new_items.append((frm, to))
-                            to_upmap[pg] = new_items
-                            found = True
-                            break
-                    if found:
-                        break
-
-            # ---- underfull pass ------------------------------------------
-            if not found:
-                for osd, deviation in by_dev:
-                    if osd not in underfull:
-                        break
-                    if abs(deviation) < max_deviation:
-                        break
-                    candidates = [
-                        (pg, items)
-                        for pg, items in sorted(m.pg_upmap_items.items())
-                        if pg not in to_skip
-                        and (not only_pools or pg.pool in only_pools)
-                    ]
-                    if aggressive:
-                        rng.shuffle(candidates)
-                    for pg, items in candidates:
-                        new_items = []
-                        for frm, to in items:
-                            if frm == osd:
-                                txn.move(pg, to, frm)
-                            else:
-                                new_items.append((frm, to))
-                        if not new_items:
-                            to_unmap.add(pg)
-                            found = True
-                            break
-                        elif len(new_items) != len(items):
-                            to_upmap[pg] = new_items
-                            found = True
-                            break
-                    if found:
-                        break
-
-            if not found:
-                if not aggressive:
-                    iter_left = 0
-                elif not skip_overfull:
-                    iter_left = 0
+        _L.inc("rounds")
+        with obs.span(
+            "balancer.round", iteration=max_iter - iter_left
+        ), _L.time("round_seconds"):
+            by_dev = sorted(
+                osd_deviation.items(), key=lambda kv: (kv[1], kv[0])
+            )
+            overfull: set[int] = set()
+            more_overfull: set[int] = set()
+            underfull: list[int] = []
+            more_underfull: list[int] = []
+            for osd, d in reversed(by_dev):
+                if d <= 0:
+                    break
+                if d > max_deviation:
+                    overfull.add(osd)
                 else:
-                    skip_overfull = False
-                break  # out of retry loop
+                    more_overfull.add(osd)
+            for osd, d in by_dev:
+                if d >= 0:
+                    break
+                if d < -max_deviation:
+                    underfull.append(osd)
+                else:
+                    more_underfull.append(osd)
+            if not underfull and not overfull:
+                break
+            using_more_overfull = False
+            if not overfull and underfull:
+                overfull = more_overfull
+                using_more_overfull = True
 
-            # ---- test_change ---------------------------------------------
-            temp_dev, new_stddev, cur_max_deviation = txn.deviations()
-            if new_stddev >= stddev:
-                if not aggressive:
+            to_skip: set = set()
+            local_fallback_retried = 0
+
+            while True:  # retry: label
+                to_unmap: set = set()
+                to_upmap: dict = {}
+                txn = st.begin()
+                found = False
+
+                # ---- overfull pass ---------------------------------------
+                if not (skip_overfull and underfull):
+                    for osd, deviation in reversed(by_dev):
+                        if deviation < 0:
+                            break
+                        if (not using_more_overfull
+                                and deviation <= max_deviation):
+                            break
+                        pgs = [
+                            pg for pg in st.pgs_of(osd)
+                            if pg not in to_skip
+                        ]
+                        if aggressive:
+                            rng.shuffle(pgs)  # equal (in)attention
+                        # 1) drop existing remaps INTO this overfull osd
+                        for pg in pgs:
+                            items = m.pg_upmap_items.get(pg)
+                            if items is None:
+                                continue
+                            new_items = []
+                            for frm, to in items:
+                                if to == osd:
+                                    txn.move(pg, to, frm)
+                                else:
+                                    new_items.append((frm, to))
+                            if not new_items:
+                                to_unmap.add(pg)
+                                found = True
+                                break
+                            elif len(new_items) != len(items):
+                                to_upmap[pg] = new_items
+                                found = True
+                                break
+                        if found:
+                            break
+                        # 2) add a new remapping pair
+                        for pg in pgs:
+                            if pg in m.pg_upmap:
+                                continue
+                            pool = m.get_pg_pool(pg.pool)
+                            new_items = list(m.pg_upmap_items.get(pg, []))
+                            if len(new_items) >= pool.size:
+                                continue
+                            existing: set[int] = set()
+                            for frm, to in new_items:
+                                existing.add(frm)
+                                existing.add(to)
+                            # raw mapping including existing upmaps
+                            raw, _ = m._pg_to_raw_osds(pool, pg)
+                            orig = list(raw)
+                            m._apply_upmap(pool, pg, orig)
+                            out = try_pg_upmap(
+                                m, pg, overfull, underfull, more_underfull,
+                                orig
+                            )
+                            if out is None or len(out) != len(orig):
+                                continue
+                            pos, max_dev = -1, 0.0
+                            for i2 in range(len(out)):
+                                if orig[i2] == out[i2]:
+                                    continue
+                                if (
+                                    orig[i2] in existing
+                                    or out[i2] in existing
+                                ):
+                                    continue
+                                d = osd_deviation.get(orig[i2], 0.0)
+                                if d > max_dev:
+                                    max_dev, pos = d, i2
+                            if pos != -1:
+                                frm, to = orig[pos], out[pos]
+                                txn.move(pg, frm, to)
+                                new_items.append((frm, to))
+                                to_upmap[pg] = new_items
+                                found = True
+                                break
+                        if found:
+                            break
+
+                # ---- underfull pass --------------------------------------
+                if not found:
+                    for osd, deviation in by_dev:
+                        if osd not in underfull:
+                            break
+                        if abs(deviation) < max_deviation:
+                            break
+                        candidates = [
+                            (pg, items)
+                            for pg, items in sorted(m.pg_upmap_items.items())
+                            if pg not in to_skip
+                            and (not only_pools or pg.pool in only_pools)
+                        ]
+                        if aggressive:
+                            rng.shuffle(candidates)
+                        for pg, items in candidates:
+                            new_items = []
+                            for frm, to in items:
+                                if frm == osd:
+                                    txn.move(pg, to, frm)
+                                else:
+                                    new_items.append((frm, to))
+                            if not new_items:
+                                to_unmap.add(pg)
+                                found = True
+                                break
+                            elif len(new_items) != len(items):
+                                to_upmap[pg] = new_items
+                                found = True
+                                break
+                        if found:
+                            break
+
+                if not found:
+                    if not aggressive:
+                        iter_left = 0
+                    elif not skip_overfull:
+                        iter_left = 0
+                    else:
+                        skip_overfull = False
+                    break  # out of retry loop
+
+                # ---- test_change -----------------------------------------
+                temp_dev, new_stddev, cur_max_deviation = txn.deviations()
+                if new_stddev >= stddev:
+                    _L.inc(
+                        "changes_rejected", len(to_unmap) + len(to_upmap)
+                    )
+                    if not aggressive:
+                        iter_left = 0
+                        break
+                    local_fallback_retried += 1
+                    if local_fallback_retried >= local_fallback_retries:
+                        skip_overfull = not skip_overfull
+                        break
+                    to_skip |= to_unmap
+                    to_skip |= set(to_upmap)
+                    continue  # goto retry
+
+                stddev = new_stddev
+                st.commit(txn)
+                osd_deviation = temp_dev
+                for pg in to_unmap:
+                    del m.pg_upmap_items[pg]
+                    res.old_pg_upmap_items.add(pg)
+                    res.num_changed += 1
+                for pg, items in to_upmap.items():
+                    m.pg_upmap_items[pg] = items
+                    res.new_pg_upmap_items[pg] = items
+                    res.num_changed += 1
+                _L.inc("changes_accepted", len(to_unmap) + len(to_upmap))
+                _L.observe("stddev", stddev)
+                _L.observe("max_deviation", cur_max_deviation)
+                obs.counter("balancer.stddev", stddev)
+                res.stddev = stddev
+                res.max_deviation = cur_max_deviation
+                if cur_max_deviation <= max_deviation:
                     iter_left = 0
-                    break
-                local_fallback_retried += 1
-                if local_fallback_retried >= local_fallback_retries:
-                    skip_overfull = not skip_overfull
-                    break
-                to_skip |= to_unmap
-                to_skip |= set(to_upmap)
-                continue  # goto retry
-
-            stddev = new_stddev
-            st.commit(txn)
-            osd_deviation = temp_dev
-            for pg in to_unmap:
-                del m.pg_upmap_items[pg]
-                res.old_pg_upmap_items.add(pg)
-                res.num_changed += 1
-            for pg, items in to_upmap.items():
-                m.pg_upmap_items[pg] = items
-                res.new_pg_upmap_items[pg] = items
-                res.num_changed += 1
-            res.stddev = stddev
-            res.max_deviation = cur_max_deviation
-            if cur_max_deviation <= max_deviation:
-                iter_left = 0
-            break  # exit retry loop, next outer iteration
+                break  # exit retry loop, next outer iteration
 
     return res

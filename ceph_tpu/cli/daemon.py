@@ -1,0 +1,132 @@
+"""daemon — the `ceph daemon <name> <command>` surface for this port.
+
+The reference queries a live daemon's internals over its admin socket
+(`ceph daemon osd.0 perf dump`, reference src/common/admin_socket.cc);
+the same commands work here in two modes:
+
+    # against a LIVE process (started with CEPH_TPU_ADMIN_SOCKET=/p/x.asok):
+    python -m ceph_tpu.cli.daemon --sock /p/x.asok perf dump
+
+    # in-process: run a small self-test workload (pipeline mapping + an
+    # RS(8,4) encode) to populate the registry, then execute the command:
+    python -m ceph_tpu.cli.daemon perf dump
+
+Commands (reference names):
+
+    perf dump     perf-dump JSON (u64 bare, avg/time_avg avgcount+sum,
+                  histogram bounds+buckets)
+    perf schema   kind + description per counter
+    perf reset    zero every counter, keep declarations
+    metrics       Prometheus text exposition (format 0.0.4)
+    trace flush   write the Chrome trace-event file (CEPH_TPU_TRACE)
+    help          command list
+
+The in-process self-test pins JAX to CPU (it is a diagnostic path — it
+must answer in seconds even when the accelerator is wedged, which is
+exactly when you reach for it); pass `--no-selftest` to skip the
+workload and dump whatever this process has, or `--sock` to inspect a
+real run on whatever device it owns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ceph_tpu.utils.dout import subsys_logger
+
+log = subsys_logger("obs")
+
+
+def _import_obs_without_serving():
+    """A one-shot diagnostic CLI never serves the admin socket itself —
+    an inherited CEPH_TPU_ADMIN_SOCKET would otherwise race the live
+    process this tool is querying (obs starts the server at first
+    import).  The env var is hidden only for the import, then restored:
+    importing this module must not mutate the process environment."""
+    saved = os.environ.pop("CEPH_TPU_ADMIN_SOCKET", None)
+    try:
+        from ceph_tpu.obs import admin_socket
+    finally:
+        if saved is not None:
+            os.environ["CEPH_TPU_ADMIN_SOCKET"] = saved
+    return admin_socket
+
+
+SELFTEST_PGS = 256
+SELFTEST_OSDS = 16
+
+
+def _selftest() -> None:
+    """A small mapping run + RS(8,4) encode so every hot-path counter
+    group (pipeline, ec) exists and has advanced."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from ceph_tpu import obs
+    from ceph_tpu.ec.registry import create_erasure_code
+    from ceph_tpu.osd.osdmap import build_hierarchical
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+    from ceph_tpu.osd.types import PgPool, PoolType
+
+    with obs.span("daemon.selftest"):
+        pool = PgPool(
+            type=PoolType.REPLICATED, size=3, crush_rule=0,
+            pg_num=SELFTEST_PGS, pgp_num=SELFTEST_PGS,
+        )
+        m = build_hierarchical(SELFTEST_OSDS // 8, 8, n_rack=1, pool=pool)
+        pm = PoolMapper(m, 0, overlays=False)
+        pm.map_batch(np.arange(SELFTEST_PGS, dtype=np.uint32))
+        log(5, f"selftest: mapped {SELFTEST_PGS} pgs")
+
+        rs = create_erasure_code({"plugin": "jax", "k": "8", "m": "4"})
+        data = np.arange(8 * 4096, dtype=np.uint8).reshape(8, 4096)
+        rs.encode_chunks(data)
+        log(5, "selftest: RS(8,4) encode done")
+
+
+def main(argv: list[str] | None = None) -> int:
+    asok = _import_obs_without_serving()
+    ap = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.cli.daemon",
+        description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument(
+        "--sock", metavar="PATH",
+        help="admin socket of a live process (CEPH_TPU_ADMIN_SOCKET); "
+        "default is in-process execution",
+    )
+    ap.add_argument(
+        "--no-selftest", action="store_true",
+        help="in-process mode: skip the counter-populating workload",
+    )
+    ap.add_argument(
+        "command", nargs="+",
+        help=f"one of: {', '.join(repr(c) for c in asok.COMMANDS)}",
+    )
+    args = ap.parse_args(argv)
+    cmd = " ".join(args.command)
+
+    if args.sock:
+        try:
+            out = asok.client_command(args.sock, cmd)
+        except OSError as e:
+            print(f"daemon: cannot reach {args.sock}: {e}", file=sys.stderr)
+            return 1
+        print(out)
+        return 0
+
+    # read-only commands benefit from a populated registry; mutating or
+    # metadata commands run against the process as-is
+    if cmd in ("perf dump", "perf schema", "metrics") and not args.no_selftest:
+        _selftest()
+    print(asok.handle_command(cmd))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,8 @@ def _vec(out) -> str:
 
 @dataclass
 class TesterConfig:
+    __test__ = False  # not a test class, despite the Test* name (pytest)
+
     min_x: int = 0
     max_x: int = 1023
     rule: int = -1  # -1 = all rules

@@ -29,9 +29,20 @@ from __future__ import annotations
 
 import numpy as np
 
+from ceph_tpu import obs
 from ceph_tpu.ec import matrices
 from ceph_tpu.ec.gf import gf_matvec_data
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
+
+_L = obs.logger_for("ec")
+_L.add_u64("bytes_encoded", "stripe bytes pushed through encode_chunks")
+_L.add_u64("bytes_decoded", "chunk bytes rebuilt by decode_chunks")
+_L.add_time_avg("encode_seconds", "encode_chunks wall time")
+_L.add_time_avg("decode_seconds", "decode_chunks wall time")
+_L.add_u64("repair_bytes", "chunk bytes rebuilt by minimum-bandwidth repair")
+_L.add_time_avg("repair_seconds", "repair wall time")
+_L.add_avg("repair_read_fraction",
+           "helper bytes read / full-stripe bytes, per repair")
 
 
 def _pow_int(a: int, x: int) -> int:
@@ -290,17 +301,21 @@ class ClayCode(ErasureCode):
             f"chunk size {cs} not a multiple of sub_chunk_no "
             f"{self.sub_chunk_no}"
         )
-        sc = cs // self.sub_chunk_no
-        ext = {i: data[i] for i in range(k)}
-        nodes = self._to_nodes(ext, sc)
-        parity_nodes = {
-            i + self.nu for i in range(k, k + m)
-        }
-        self._decode_layered(parity_nodes, nodes)
-        out = np.zeros((k + m, cs), np.uint8)
-        for i in range(k + m):
-            nid = i if i < k else i + self.nu
-            out[i] = nodes[nid].reshape(-1)
+        with obs.span(
+            "ec.clay_encode", k=k, m=m, d=self.d, bytes=int(data.size)
+        ), _L.time("encode_seconds"):
+            sc = cs // self.sub_chunk_no
+            ext = {i: data[i] for i in range(k)}
+            nodes = self._to_nodes(ext, sc)
+            parity_nodes = {
+                i + self.nu for i in range(k, k + m)
+            }
+            self._decode_layered(parity_nodes, nodes)
+            out = np.zeros((k + m, cs), np.uint8)
+            for i in range(k + m):
+                nid = i if i < k else i + self.nu
+                out[i] = nodes[nid].reshape(-1)
+        _L.inc("bytes_encoded", int(data.size))
         return out
 
     def decode_chunks(
@@ -312,21 +327,27 @@ class ClayCode(ErasureCode):
         k, m = self.k, self.m
         if len(chunks) < k:
             raise ValueError(f"cannot decode: {len(chunks)} < k={k}")
-        sc = chunk_size // self.sub_chunk_no
         erased = {
             (i if i < k else i + self.nu)
             for i in range(k + m)
             if i not in chunks
         }
-        nodes = self._to_nodes(
-            {i: np.asarray(c, np.uint8) for i, c in chunks.items()}, sc
-        )
-        self._decode_layered(erased, nodes)
-        out = dict(chunks)
-        for i in range(k + m):
-            nid = i if i < k else i + self.nu
-            if i not in out:
-                out[i] = nodes[nid].reshape(-1)
+        n_missing = len(erased)
+        with obs.span(
+            "ec.clay_decode", k=k, m=m, missing=n_missing,
+            bytes=n_missing * chunk_size,
+        ), _L.time("decode_seconds"):
+            sc = chunk_size // self.sub_chunk_no
+            nodes = self._to_nodes(
+                {i: np.asarray(c, np.uint8) for i, c in chunks.items()}, sc
+            )
+            self._decode_layered(erased, nodes)
+            out = dict(chunks)
+            for i in range(k + m):
+                nid = i if i < k else i + self.nu
+                if i not in out:
+                    out[i] = nodes[nid].reshape(-1)
+        _L.inc("bytes_decoded", n_missing * chunk_size)
         return out
 
     # -- repair (minimum-bandwidth single-node recovery) -------------------
@@ -401,6 +422,26 @@ class ClayCode(ErasureCode):
         arrays may be full chunks or just the repair sub-chunk runs
         (repair_blocksize = chunk_size/q).  reference repair :390 +
         repair_one_lost_chunk :462."""
+        read_bytes = sum(
+            int(np.asarray(b).size) for b in helper_chunks.values()
+        )
+        with obs.span(
+            "ec.clay_repair", k=self.k, m=self.m, d=self.d,
+            helpers=len(helper_chunks), read_bytes=read_bytes,
+        ), _L.time("repair_seconds"):
+            out = self._repair(want_to_read, helper_chunks, chunk_size)
+        _L.inc("repair_bytes", len(want_to_read) * chunk_size)
+        _L.observe(
+            "repair_read_fraction", read_bytes / (self.k * chunk_size)
+        )
+        return out
+
+    def _repair(
+        self,
+        want_to_read: set[int],
+        helper_chunks: dict[int, np.ndarray],
+        chunk_size: int,
+    ) -> dict[int, np.ndarray]:
         assert len(want_to_read) == 1
         assert len(helper_chunks) == self.d
         q, t = self.q, self.t

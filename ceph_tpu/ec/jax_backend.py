@@ -26,9 +26,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ceph_tpu import obs
 from ceph_tpu.ec.gf import GF_EXP, GF_LOG, matrix_to_bitmatrix
 
 _BIT_TILE = 1 << 17  # bytes per lane-tile in the bitplane path
+
+_L = obs.logger_for("ec")
+
+
+def _matmul_key(eng, M, data) -> tuple:
+    """Warm-key granularity mirrors the actual jit caches: bitplane /
+    pallas trace on array shapes only (the bitmatrix is a traced
+    operand), while logexp passes the matrix as a static tuple and
+    recompiles per content."""
+    mat_key = eng._key(M) if eng.strategy == "logexp" else M.shape
+    return (mat_key, np.shape(data), eng.strategy)
+
+
+# Module-level (one shared warm set) because the jit caches it models
+# (_matmul_bitplane etc.) are also process-global: a second JaxEngine's
+# first call on a warm shape is a dispatch, not a compile.
+_gf_acct = obs.JitAccount(
+    lambda eng, M, data: eng._matmul(M, data), _L, "gf",
+    key_fn=_matmul_key,
+    span="ec.gf_matmul",
+    span_args=lambda eng, M, data: {
+        "rows": int(M.shape[0]),
+        "bytes": int(np.prod(np.shape(data))),
+        "strategy": eng.strategy,
+    },
+)
 
 
 @partial(jax.jit, static_argnums=(2,))
@@ -154,7 +181,16 @@ class JaxEngine:
         return B
 
     def matmul(self, M: np.ndarray, data):
+        """Instrumented entry point: spans + compile/dispatch split.  A
+        (matrix, shape, strategy) triple not seen by this process before
+        pays the jit trace+compile; its wall time books into
+        ec.gf_compile_seconds, steady-state calls into
+        ec.gf_dispatch_seconds (dispatch only — device completion is the
+        caller's fetch)."""
         M = np.asarray(M, np.uint8)
+        return _gf_acct(self, M, data)
+
+    def _matmul(self, M: np.ndarray, data):
         on_device = isinstance(data, jax.Array)
         d = data if on_device else jnp.asarray(data, jnp.uint8)
         S, L = d.shape

@@ -13,9 +13,16 @@ from __future__ import annotations
 
 import numpy as np
 
+from ceph_tpu import obs
 from ceph_tpu.ec import matrices
 from ceph_tpu.ec.gf import gf_matvec_data
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
+
+_L = obs.logger_for("ec")
+_L.add_u64("bytes_encoded", "stripe bytes pushed through encode_chunks")
+_L.add_u64("bytes_decoded", "chunk bytes rebuilt by decode_chunks")
+_L.add_time_avg("encode_seconds", "encode_chunks wall time")
+_L.add_time_avg("decode_seconds", "decode_chunks wall time")
 
 
 def _is_device_array(x) -> bool:
@@ -119,14 +126,20 @@ class RSErasureCode(ErasureCode):
 
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
         assert data.shape[0] == self.k
-        if _is_device_array(data):
-            import jax.numpy as jnp  # device stripes stay on device
+        with obs.span(
+            "ec.encode", k=self.k, m=self.m, bytes=int(data.size)
+        ), _L.time("encode_seconds"):
+            if _is_device_array(data):
+                import jax.numpy as jnp  # device stripes stay on device
 
-            parity = self.engine.matmul(self.C, data)
-            return jnp.concatenate([data, parity], axis=0)
-        data = np.asarray(data, np.uint8)
-        parity = self.engine.matmul(self.C, data)
-        return np.concatenate([data, np.asarray(parity)], axis=0)
+                parity = self.engine.matmul(self.C, data)
+                out = jnp.concatenate([data, parity], axis=0)
+            else:
+                data = np.asarray(data, np.uint8)
+                parity = self.engine.matmul(self.C, data)
+                out = np.concatenate([data, np.asarray(parity)], axis=0)
+        _L.inc("bytes_encoded", int(data.size))
+        return out
 
     def decode_chunks(
         self,
@@ -141,16 +154,23 @@ class RSErasureCode(ErasureCode):
             )
         use = present[: self.k]
         missing = sorted(set(want_to_read) - set(chunks))
-        if any(_is_device_array(chunks[i]) for i in use):
-            import jax.numpy as jnp
+        with obs.span(
+            "ec.decode", k=self.k, m=self.m, missing=len(missing),
+            bytes=len(missing) * chunk_size,
+        ), _L.time("decode_seconds"):
+            if any(_is_device_array(chunks[i]) for i in use):
+                import jax.numpy as jnp
 
-            stack = jnp.stack([chunks[i] for i in use])
-        else:
-            stack = np.stack([np.asarray(chunks[i], np.uint8) for i in use])
-        out = dict(chunks)
-        if missing:
-            R = matrices.recover_matrix(self.C, use, missing)
-            rebuilt = self.engine.matmul(R, stack)
-            for row, i in enumerate(missing):
-                out[i] = rebuilt[row]
+                stack = jnp.stack([chunks[i] for i in use])
+            else:
+                stack = np.stack(
+                    [np.asarray(chunks[i], np.uint8) for i in use]
+                )
+            out = dict(chunks)
+            if missing:
+                R = matrices.recover_matrix(self.C, use, missing)
+                rebuilt = self.engine.matmul(R, stack)
+                for row, i in enumerate(missing):
+                    out[i] = rebuilt[row]
+        _L.inc("bytes_decoded", len(missing) * chunk_size)
         return out

@@ -1,0 +1,76 @@
+"""Observability layer: spans + perf counters + JAX-aware accounting.
+
+One import surface for the hot paths:
+
+    from ceph_tpu import obs
+
+    L = obs.logger_for("pipeline")        # perf-counter group
+    L.add_u64("pgs_mapped")
+    with obs.span("pipeline.map_block", pgs=n):
+        ...
+        L.inc("pgs_mapped", n)
+
+Three cooperating pieces (each usable alone):
+
+- `trace`: nested, thread-safe span tracer, env-gated via
+  `CEPH_TPU_TRACE=<path>`, exported as Chrome trace-event JSON (open in
+  Perfetto: https://ui.perfetto.dev).
+- `perf_counters` (ceph_tpu.utils): the reference's perf-dump registry
+  (u64 / avg / time_avg / histogram), exposed by
+  `python -m ceph_tpu.cli.daemon perf dump|metrics` and, for live
+  processes, the env-gated admin socket (`CEPH_TPU_ADMIN_SOCKET`).
+- `jax_accounting`: compile vs dispatch vs device→host-transfer time per
+  jitted entry point (first-call-per-shape = compile).
+
+Importing this package is cheap (no jax import) and, when
+`CEPH_TPU_ADMIN_SOCKET` is set, starts the admin-socket server.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.obs import trace
+from ceph_tpu.obs.admin_socket import maybe_start_from_env
+from ceph_tpu.obs.jax_accounting import JitAccount, timed_fetch
+from ceph_tpu.obs.trace import (
+    counter,
+    flush,
+    instant,
+    set_trace_path,
+    span,
+    trace_path,
+)
+from ceph_tpu.utils.perf_counters import (
+    UndeclaredCounterError,
+    logger_for,
+    perf_dump,
+    perf_schema,
+    reset_values,
+)
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of the whole perf registry."""
+    from ceph_tpu.obs.prometheus import prometheus_text as _render
+
+    return _render(perf_dump())
+
+
+maybe_start_from_env()
+
+__all__ = [
+    "JitAccount",
+    "UndeclaredCounterError",
+    "counter",
+    "flush",
+    "instant",
+    "logger_for",
+    "perf_dump",
+    "perf_schema",
+    "prometheus_text",
+    "reset_values",
+    "set_trace_path",
+    "span",
+    "timed_fetch",
+    "trace",
+    "trace_path",
+]

@@ -1,0 +1,203 @@
+"""Admin socket — query a LIVE process's perf registry from the outside.
+
+The reference exposes every daemon's internals on a UNIX stream socket
+(`ceph daemon <name> perf dump`, reference src/common/admin_socket.cc:
+one command line per connection, JSON reply, connection closed).  Same
+protocol here:
+
+    client: "perf dump\\n"      server: perf-dump JSON
+    client: "perf schema\\n"    server: perf-schema JSON
+    client: "perf reset\\n"     server: {"ok": true} (values zeroed)
+    client: "metrics\\n"        server: Prometheus text exposition
+    client: "trace flush\\n"    server: {"path": <trace file or null>}
+    client: "help\\n"           server: command list JSON
+
+Env-gated like tracing: set `CEPH_TPU_ADMIN_SOCKET=/path/x.asok` and any
+process that imports ceph_tpu.obs serves on it; then from another shell:
+
+    python -m ceph_tpu.cli.daemon --sock /path/x.asok perf dump
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import threading
+
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("obs")
+
+_server: "AdminSocket | None" = None
+
+COMMANDS = (
+    "perf dump", "perf schema", "perf reset", "metrics", "trace flush",
+    "help",
+)
+
+
+def handle_command(cmd: str) -> str:
+    """Execute one admin command against this process; returns the reply
+    text.  Shared by the socket server and the in-process CLI path."""
+    from ceph_tpu.obs import trace
+    from ceph_tpu.obs.prometheus import prometheus_text
+    from ceph_tpu.utils import perf_counters as pc
+
+    cmd = " ".join(cmd.split())
+    if cmd == "perf dump":
+        return json.dumps(pc.perf_dump(), indent=1, sort_keys=True)
+    if cmd == "perf schema":
+        return json.dumps(pc.perf_schema(), indent=1, sort_keys=True)
+    if cmd == "perf reset":
+        pc.reset_values()
+        return json.dumps({"ok": True})
+    if cmd == "metrics":
+        return prometheus_text(pc.perf_dump())
+    if cmd == "trace flush":
+        return json.dumps({"path": trace.flush()})
+    if cmd == "help":
+        return json.dumps(list(COMMANDS))
+    return json.dumps({"error": f"unknown command {cmd!r}", "help": list(COMMANDS)})
+
+
+class AdminSocket:
+    """Threaded UNIX stream server; one command per connection."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.bind(path)
+        self.sock.listen(4)
+        self._stop = False
+        self.thread = threading.Thread(
+            target=self._serve, name="ceph-tpu-asok", daemon=True
+        )
+        self.thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(5)
+                buf = b""
+                while b"\n" not in buf:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                cmd = buf.split(b"\n", 1)[0].decode("utf-8", "replace")
+                if cmd:
+                    try:
+                        reply = handle_command(cmd)
+                    except Exception as e:
+                        # the client must see the failure, not an empty
+                        # reply that reads as success
+                        reply = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        )
+                    conn.sendall(reply.encode())
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self.sock.close()
+        finally:
+            if os.path.exists(self.path):
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+
+def client_command(path: str, cmd: str, timeout: float = 10.0) -> str:
+    """Send one command to a live process's admin socket."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(path)
+        s.sendall(cmd.encode() + b"\n")
+        s.shutdown(socket.SHUT_WR)
+        out = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            out += chunk
+        return out.decode()
+    finally:
+        s.close()
+
+
+def start(path: str) -> AdminSocket:
+    """Start (or replace) this process's admin socket server."""
+    global _server
+    if _server is not None:
+        _server.close()
+    _server = AdminSocket(path)
+    return _server
+
+
+def release() -> None:
+    """Stop serving and free the socket path.
+
+    For supervisor/worker process pairs sharing one environment (bench.py):
+    the UNIX path can only name one server, and the interesting registry
+    lives in the worker — the supervisor calls this before spawning, so
+    the worker's own `maybe_start_from_env` binds the path uncontested."""
+    global _server
+    if _server is not None:
+        _server.close()
+        _server = None
+
+
+def _path_serving(path: str) -> bool:
+    """True if a live server already answers on `path` (a stale socket
+    file left by a killed process refuses the connect)."""
+    if not os.path.exists(path):
+        return False
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(0.5)
+    try:
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def maybe_start_from_env() -> AdminSocket | None:
+    path = os.environ.get("CEPH_TPU_ADMIN_SOCKET")
+    if path and _server is None:
+        # never steal a live server's path: a client shell with the env
+        # var still exported imports obs too, and must not unlink the
+        # socket of the process it is about to query
+        if _path_serving(path):
+            return None
+        try:
+            return start(path)
+        except OSError as e:
+            # a bad socket path (missing dir, unwritable, too long) must
+            # not crash every module that imports obs
+            _log(1, f"cannot serve admin socket {path}: {e}")
+            return None
+    return _server
+
+
+def _cleanup() -> None:
+    if _server is not None:
+        _server.close()
+
+
+atexit.register(_cleanup)

@@ -1,0 +1,104 @@
+"""JAX-aware time accounting: compile vs dispatch vs device→host transfer.
+
+A jitted entry point's first call traces + compiles (tens of seconds for
+the big pipeline kernels); steady-state calls only dispatch.  A headline
+number that mixes the two is exactly the diagnostic gap observability is
+meant to close (BENCH_r05: 24.7s cold compiles hidden in one number), so
+every instrumented jit callsite routes through `JitAccount`, which books
+the two phases into separate counters:
+
+    <key>_compiles          u64       how many cold (compile) calls
+    <key>_compile_seconds   time_avg  wall time of cold calls
+    <key>_dispatch_seconds  time_avg  wall time of steady-state calls
+
+and wraps each call in a span ("<group>.<key>.compile" / ".dispatch").
+
+Cold-call detection is per (wrapper, input-shape-signature): jax retraces
+per shape, and the instrumented drivers call each wrapper with a fixed
+block shape, so the first call per signature IS the compile.  Dispatch
+timing does not block on the result — it measures enqueue cost, honest
+for async callers; callers that want completion timed use `timed_fetch`
+(device→host transfer + forced completion) which books
+
+    <key>_fetch_seconds     time_avg  d2h transfer (np.asarray) wall time
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ceph_tpu.obs import trace
+from ceph_tpu.utils.perf_counters import PerfCounters
+
+
+def _sig(args) -> tuple:
+    """Shape signature of positional args (arrays by shape+dtype, dicts
+    by sorted keys, scalars by type)."""
+    out = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            out.append((tuple(shape), str(getattr(a, "dtype", ""))))
+        elif isinstance(a, dict):
+            out.append(tuple(sorted(a)))
+        else:
+            out.append(type(a).__name__)
+    return tuple(out)
+
+
+class JitAccount:
+    """Wrap a jitted callable with compile/dispatch accounting.
+
+    `key_fn(*args)` overrides the default shape signature when the
+    wrapped function's recompile granularity is not purely shape-based
+    (e.g. a matrix passed as static content retraces per matrix);
+    `span` overrides the span base name and `span_args(*args)` supplies
+    per-call span arguments."""
+
+    def __init__(
+        self, fn, logger: PerfCounters, key: str,
+        key_fn=None, span: str | None = None, span_args=None,
+    ):
+        self.fn = fn
+        self.log = logger
+        self.key = key
+        self.key_fn = key_fn
+        self.span = span or f"{logger.name}.{key}"
+        self.span_args = span_args
+        self._seen: set[tuple] = set()
+        logger.add_u64(f"{key}_compiles", "cold (trace+compile) calls")
+        logger.add_time_avg(f"{key}_compile_seconds", "cold call wall time")
+        logger.add_time_avg(
+            f"{key}_dispatch_seconds", "steady-state dispatch wall time"
+        )
+
+    def __call__(self, *args, **kw):
+        sig = self.key_fn(*args) if self.key_fn else _sig(args)
+        cold = sig not in self._seen
+        phase = "compile" if cold else "dispatch"
+        extra = self.span_args(*args) if self.span_args else {}
+        with trace.span(f"{self.span}.{phase}", **extra):
+            t0 = time.perf_counter()
+            out = self.fn(*args, **kw)
+            dt = time.perf_counter() - t0
+        if cold:
+            self._seen.add(sig)
+            self.log.inc(f"{self.key}_compiles")
+            self.log.observe(f"{self.key}_compile_seconds", dt)
+        else:
+            self.log.observe(f"{self.key}_dispatch_seconds", dt)
+        return out
+
+
+def timed_fetch(logger: PerfCounters, key: str, x):
+    """np.asarray(x) with the d2h transfer (which also forces completion
+    of the producing computation) booked into <key>_fetch_seconds."""
+    name = f"{key}_fetch_seconds"
+    # declare-on-first-use: add_time_avg is idempotent, so re-declaring
+    # on every call is safe (one lock acquisition, no state churn)
+    logger.add_time_avg(name, "device->host transfer wall time")
+    with trace.span(f"{logger.name}.{key}.fetch"):
+        with logger.time(name):
+            return np.asarray(x)

@@ -1,0 +1,69 @@
+"""Prometheus text exposition (format 0.0.4) over the perf registry.
+
+Mapping from perf-counter kinds:
+
+    u64        -> counter      ceph_tpu_<group>_<key>
+    avg        -> summary      _sum / _count
+    time_avg   -> summary      _sum / _count (seconds)
+    histogram  -> histogram    cumulative _bucket{le=...} / _sum / _count
+
+Group and key names are sanitized to the Prometheus metric charset
+([a-zA-Z_][a-zA-Z0-9_]*); '.' and '-' become '_'.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ceph_tpu.utils.perf_counters import perf_schema
+
+_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _name(group: str, key: str) -> str:
+    return _BAD.sub("_", f"ceph_tpu_{group}_{key}")
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and v != v:  # NaN
+        return "NaN"
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(dump: dict, schema: dict | None = None) -> str:
+    """Render a perf_dump() dict; `schema` (perf_schema()) supplies kinds
+    and HELP strings — without it kinds are inferred from value shapes."""
+    schema = schema if schema is not None else perf_schema()
+    lines: list[str] = []
+    for group in sorted(dump):
+        for key in sorted(dump[group]):
+            v = dump[group][key]
+            name = _name(group, key)
+            meta = (schema.get(group) or {}).get(key, {})
+            desc = meta.get("description") or f"{group}.{key}"
+            kind = meta.get("type")
+            if kind is None:  # infer
+                if isinstance(v, dict):
+                    kind = "histogram" if "buckets" in v else "avg"
+                else:
+                    kind = "u64"
+            lines.append(f"# HELP {name} {desc}")
+            if kind == "u64":
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(v)}")
+            elif kind in ("avg", "time_avg"):
+                lines.append(f"# TYPE {name} summary")
+                lines.append(f"{name}_sum {_fmt(float(v['sum']))}")
+                lines.append(f"{name}_count {v['avgcount']}")
+            else:  # histogram
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for bound, n in zip(v["bounds"], v["buckets"]):
+                    cum += n
+                    lines.append(
+                        f'{name}_bucket{{le="{_fmt(float(bound))}"}} {cum}'
+                    )
+                lines.append(f'{name}_bucket{{le="+Inf"}} {sum(v["buckets"])}')
+                lines.append(f"{name}_sum {_fmt(float(v['sum']))}")
+                lines.append(f"{name}_count {v['count']}")
+    return "\n".join(lines) + "\n"

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ceph_tpu import obs
 from ceph_tpu.core.intmath import pg_mask_for, stable_mod
 from ceph_tpu.core.rjenkins import crush_hash32_2
 from ceph_tpu.crush import mapper_ref
@@ -42,6 +43,12 @@ from ceph_tpu.osd.osdmap import (
     OSDMap,
 )
 from ceph_tpu.osd.types import FLAG_HASHPSPOOL
+
+
+_L = obs.logger_for("pipeline")
+_L.add_u64("pgs_mapped", "placement seeds mapped through the batched pipeline")
+_L.add_u64("unresolved_pgs", "fast-window inconclusive lanes (exact-loop rescued)")
+_L.add_u64("rescue_invocations", "loop-kernel rescue passes")
 
 
 def _h2(a, b):
@@ -431,9 +438,15 @@ class PoolMapper:
 
     def jitted_fast(self):
         """The jitted vmapped fast pipeline (with unresolved flag); one
-        trace cache shared by map_batch and external batch drivers."""
+        trace cache shared by map_batch and external batch drivers.
+        Wrapped in compile/dispatch accounting (obs.JitAccount): the
+        perf dump separates `fast_compile_seconds` (first call per block
+        shape) from `fast_dispatch_seconds`."""
         if self._jitted is None:
-            self._jitted = jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0)))
+            self._jitted = obs.JitAccount(
+                jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0))),
+                _L, "fast",
+            )
         return self._jitted
 
     def jitted_loop(self):
@@ -442,7 +455,10 @@ class PoolMapper:
             loop_fn = compile_pipeline(
                 self.arrays, self.spec, path="loop", **self._pipe_kw
             )
-            self._jloop = jax.jit(jax.vmap(loop_fn, in_axes=(0, None, 0)))
+            self._jloop = obs.JitAccount(
+                jax.jit(jax.vmap(loop_fn, in_axes=(0, None, 0))),
+                _L, "loop",
+            )
         return self._jloop
 
     def _ov_rows(self, ps: np.ndarray) -> dict:
@@ -475,34 +491,44 @@ class PoolMapper:
             parts = []
             for i in range(0, len(ps), B):
                 blk = ps[i:i + B]
-                sub = self._map_block(np.resize(blk, B))
+                sub = self._map_block(np.resize(blk, B), n_real=len(blk))
                 parts.append(tuple(o[: len(blk)] for o in sub))
             return tuple(
                 np.concatenate([p[j] for p in parts]) for j in range(4)
             )
         return self._map_block(ps)
 
-    def _map_block(self, ps: np.ndarray):
-        *out, flg = self.jitted_fast()(
-            jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
-        )
-        flg = np.asarray(flg)
-        if flg.any():
-            jloop = self.jitted_loop()
-            out = [np.array(o) for o in out]  # writable copies
-            idx = np.nonzero(flg)[0]
-            P = RESCUE_PAD
-            for i in range(0, len(idx), P):
-                blk = idx[i:i + P]
-                pad = np.resize(blk, P)  # cycle-pad: one compile per shape
-                sub = jloop(
-                    jnp.asarray(ps[pad], np.uint32), self.dev,
-                    self._ov_rows(ps[pad]),
-                )
-                for o, s in zip(out, sub):
-                    o[blk] = np.asarray(s)[: len(blk)]
-            return tuple(out)
-        return tuple(np.asarray(o) for o in out)
+    def _map_block(self, ps: np.ndarray, n_real: int | None = None):
+        # n_real: distinct seeds in a cycle-padded tail block — the
+        # counters book real placement work, not pad-lane duplicates
+        n = len(ps) if n_real is None else n_real
+        with obs.span("pipeline.map_block", pgs=n):
+            *out, flg = self.jitted_fast()(
+                jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
+            )
+            flg = obs.timed_fetch(_L, "result", flg)
+            _L.inc("pgs_mapped", n)
+            if flg.any():
+                idx = np.nonzero(flg)[0]
+                _L.inc("unresolved_pgs", int((idx < n).sum()))
+                _L.inc("rescue_invocations")
+                with obs.span("pipeline.rescue", lanes=len(idx)):
+                    jloop = self.jitted_loop()
+                    out = [np.array(o) for o in out]  # writable copies
+                    P = RESCUE_PAD
+                    for i in range(0, len(idx), P):
+                        blk = idx[i:i + P]
+                        # cycle-pad: one compile per shape
+                        pad = np.resize(blk, P)
+                        sub = jloop(
+                            jnp.asarray(ps[pad], np.uint32), self.dev,
+                            self._ov_rows(ps[pad]),
+                        )
+                        for o, s in zip(out, sub):
+                            o[blk] = np.asarray(s)[: len(blk)]
+                    return tuple(out)
+            with obs.span("pipeline.fetch"):
+                return tuple(np.asarray(o) for o in out)
 
     def map_all(self):
         return self.map_batch(np.arange(self.spec.pg_num, dtype=np.uint32))
@@ -531,26 +557,33 @@ class PoolMapper:
             ps = jnp.asarray(
                 (np.arange(i * B, (i + 1) * B) % n).astype(np.uint32)
             )
-            up, _, _, _, flg = vfast(ps, self.dev, {})
+            with obs.span("pipeline.map_block", pgs=B, device_resident=True):
+                up, _, _, _, flg = vfast(ps, self.dev, {})
             ups.append(up)
             flgs.append(flg)
             nflg = nflg + flg.sum()
+        _L.inc("pgs_mapped", n)  # not nb*B: pad lanes are not real PGs
         rows = (jnp.concatenate(ups) if len(ups) > 1 else ups[0])[:n]
         if int(nflg):
+            _L.inc("rescue_invocations")
             vloop = self.jitted_loop()
-            for bi, f in enumerate(flgs):
-                fv = np.asarray(f)
-                if not fv.any():
-                    continue
-                idx = np.nonzero(fv)[0] + bi * B
-                idx = idx[idx < n]
-                for i in range(0, len(idx), RESCUE_PAD):
-                    blk = idx[i:i + RESCUE_PAD]
-                    pad = np.resize(blk, RESCUE_PAD)  # fixed shape
-                    up, _, _, _ = vloop(
-                        jnp.asarray(pad.astype(np.uint32)), self.dev, {}
-                    )
-                    rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
+            n_unres = 0
+            with obs.span("pipeline.rescue", lanes=int(nflg)):
+                for bi, f in enumerate(flgs):
+                    fv = np.asarray(f)
+                    if not fv.any():
+                        continue
+                    idx = np.nonzero(fv)[0] + bi * B
+                    idx = idx[idx < n]
+                    n_unres += len(idx)
+                    for i in range(0, len(idx), RESCUE_PAD):
+                        blk = idx[i:i + RESCUE_PAD]
+                        pad = np.resize(blk, RESCUE_PAD)  # fixed shape
+                        up, _, _, _ = vloop(
+                            jnp.asarray(pad.astype(np.uint32)), self.dev, {}
+                        )
+                        rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
+            _L.inc("unresolved_pgs", n_unres)
         return rows
 
 

@@ -7,6 +7,16 @@ Mirrors the reference's per-daemon counter surface
 src/common/admin_socket.cc).  Here: a registry of named counters with the
 same shapes, a `dump()` that matches the perf-dump JSON layout, and a
 `logger_for` helper the hot paths use.
+
+Declarations are idempotent (re-declaring a key with the same kind keeps
+the live counter — hot paths declare at import time and may be reloaded),
+and updates to undeclared keys raise `UndeclaredCounterError` naming the
+group and key instead of a bare KeyError.
+
+`perf reset` semantics: `reset_values()` zeroes every counter but keeps
+the declarations (the reference's `perf reset all`); `reset()` (test
+isolation) does the same — declarations are made at import time by
+module globals, so they are never dropped, only zeroed.
 """
 
 from __future__ import annotations
@@ -14,6 +24,16 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+
+KINDS = ("u64", "avg", "time_avg", "histogram")
+
+
+class UndeclaredCounterError(KeyError):
+    """An inc/set/observe hit a key that was never declared."""
+
+
+class CounterKindError(ValueError):
+    """A declaration or update conflicts with the counter's kind."""
 
 
 @dataclass
@@ -27,6 +47,25 @@ class _Counter:
     desc: str = ""
 
 
+class _Timer:
+    """Prebuilt timing context manager — `time()` sits inside the code
+    being measured, so it must not allocate a type object per call."""
+
+    __slots__ = ("pc", "key", "t0")
+
+    def __init__(self, pc: "PerfCounters", key: str):
+        self.pc = pc
+        self.key = key
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.pc.observe(self.key, time.perf_counter() - self.t0)
+        return False
+
+
 class PerfCounters:
     """One named group of counters (a daemon's `logger` equivalent)."""
 
@@ -36,35 +75,89 @@ class PerfCounters:
         self._lock = threading.Lock()
 
     # -- declaration -------------------------------------------------------
+    def _declare(
+        self, key: str, kind: str, desc: str,
+        bounds: list[float] | None = None,
+    ) -> _Counter:
+        with self._lock:
+            c = self._c.get(key)
+            if c is not None:
+                if c.kind != kind:
+                    raise CounterKindError(
+                        f"perf counter '{self.name}.{key}' already declared "
+                        f"as {c.kind}, cannot redeclare as {kind}"
+                    )
+                if bounds is not None and list(bounds) != c.bucket_bounds:
+                    raise CounterKindError(
+                        f"perf counter '{self.name}.{key}' already declared "
+                        f"with bounds {c.bucket_bounds}, cannot redeclare "
+                        f"with {bounds}"
+                    )
+                if desc:
+                    c.desc = desc
+                return c  # idempotent: keep the live counter + its values
+            c = _Counter(kind, desc=desc)
+            if bounds is not None:
+                # under the lock: a half-initialized histogram must never
+                # be observable
+                c.bucket_bounds = list(bounds)
+                c.buckets = [0] * (len(bounds) + 1)
+            self._c[key] = c
+            return c
+
     def add_u64(self, key: str, desc: str = "") -> None:
-        self._c[key] = _Counter("u64", desc=desc)
+        self._declare(key, "u64", desc)
 
     def add_avg(self, key: str, desc: str = "") -> None:
-        self._c[key] = _Counter("avg", desc=desc)
+        self._declare(key, "avg", desc)
 
     def add_time_avg(self, key: str, desc: str = "") -> None:
-        self._c[key] = _Counter("time_avg", desc=desc)
+        self._declare(key, "time_avg", desc)
 
     def add_histogram(
         self, key: str, bounds: list[float], desc: str = ""
     ) -> None:
-        c = _Counter("histogram", desc=desc)
-        c.bucket_bounds = list(bounds)
-        c.buckets = [0] * (len(bounds) + 1)
-        self._c[key] = c
+        self._declare(key, "histogram", desc, bounds=bounds)
+
+    def _get(self, key: str) -> _Counter:
+        try:
+            return self._c[key]
+        except KeyError:
+            raise UndeclaredCounterError(
+                f"perf counter '{self.name}.{key}' is not declared "
+                "(declare it first with add_u64/add_avg/add_time_avg/"
+                "add_histogram)"
+            ) from None
 
     # -- updates -----------------------------------------------------------
     def inc(self, key: str, n: int = 1) -> None:
         with self._lock:
-            self._c[key].value += n
+            c = self._get(key)
+            if c.kind != "u64":
+                raise CounterKindError(
+                    f"perf counter '{self.name}.{key}' is {c.kind}; "
+                    "inc() needs a u64 (use observe() instead)"
+                )
+            c.value += n
 
     def set(self, key: str, v: int) -> None:
         with self._lock:
-            self._c[key].value = v
+            c = self._get(key)
+            if c.kind != "u64":
+                raise CounterKindError(
+                    f"perf counter '{self.name}.{key}' is {c.kind}; "
+                    "set() needs a u64"
+                )
+            c.value = v
 
     def observe(self, key: str, v: float) -> None:
         with self._lock:
-            c = self._c[key]
+            c = self._get(key)
+            if c.kind == "u64":
+                raise CounterKindError(
+                    f"perf counter '{self.name}.{key}' is u64; "
+                    "observe() needs avg/time_avg/histogram (use inc())"
+                )
             if c.kind == "histogram":
                 i = 0
                 while i < len(c.bucket_bounds) and v > c.bucket_bounds[i]:
@@ -73,35 +166,27 @@ class PerfCounters:
             c.sum += v
             c.count += 1
 
-    def time(self, key: str):
+    def time(self, key: str) -> "_Timer":
         """Context manager recording elapsed seconds into a time_avg."""
-        pc = self
-
-        class _T:
-            def __enter__(self):
-                self.t0 = time.perf_counter()
-                return self
-
-            def __exit__(self, *exc):
-                pc.observe(key, time.perf_counter() - self.t0)
-                return False
-
-        return _T()
+        return _Timer(self, key)
 
     # -- dump (perf-dump JSON layout) ---------------------------------------
     def dump(self) -> dict:
+        """Values in the reference perf-dump shape: u64 as bare ints, avg
+        as {avgcount, sum}, time_avg as {avgcount, sum, avgtime},
+        histogram as bounds+buckets+sum+count."""
         out: dict = {}
         with self._lock:
             for key, c in self._c.items():
                 if c.kind == "u64":
                     out[key] = c.value
-                elif c.kind in ("avg", "time_avg"):
+                elif c.kind == "avg":
+                    out[key] = {"avgcount": c.count, "sum": c.sum}
+                elif c.kind == "time_avg":
                     out[key] = {
                         "avgcount": c.count,
                         "sum": c.sum,
-                        "avgtime" if c.kind == "time_avg" else "avg": (
-                            c.sum / c.count if c.count else 0.0
-                        ),
+                        "avgtime": c.sum / c.count if c.count else 0.0,
                     }
                 else:
                     out[key] = {
@@ -111,6 +196,23 @@ class PerfCounters:
                         "count": c.count,
                     }
         return out
+
+    def schema(self) -> dict:
+        """The `perf schema` shape: kind + description per key."""
+        with self._lock:
+            return {
+                key: {"type": c.kind, "description": c.desc}
+                for key, c in self._c.items()
+            }
+
+    def reset_values(self) -> None:
+        """Zero every counter, keep the declarations (`perf reset all`)."""
+        with self._lock:
+            for c in self._c.values():
+                c.value = 0
+                c.sum = 0.0
+                c.count = 0
+                c.buckets = [0] * len(c.buckets)
 
 
 _registry: dict[str, PerfCounters] = {}
@@ -128,9 +230,29 @@ def logger_for(name: str) -> PerfCounters:
 def perf_dump() -> dict:
     """All groups — the `ceph daemon ... perf dump` shape."""
     with _registry_lock:
-        return {name: pc.dump() for name, pc in _registry.items()}
+        return {name: pc.dump() for name, pc in sorted(_registry.items())}
+
+
+def perf_schema() -> dict:
+    """All groups' declarations — the `perf schema` shape."""
+    with _registry_lock:
+        return {name: pc.schema() for name, pc in sorted(_registry.items())}
+
+
+def reset_values() -> None:
+    """Zero every counter in every group, keeping declarations."""
+    with _registry_lock:
+        for pc in _registry.values():
+            pc.reset_values()
 
 
 def reset() -> None:
-    with _registry_lock:
-        _registry.clear()
+    """Test isolation: zero every counter in every group.
+
+    Deliberately does NOT drop the registry dict: hot-path modules bind
+    `logger_for(...)` to a module global at import time, and import-time
+    declarations cannot re-run — dropping the dict would orphan those
+    live groups, silently removing them from every later perf dump.
+    Declarations are idempotent, so a test re-declaring its keys on a
+    zeroed group gets exactly the clean slate it wants."""
+    reset_values()

@@ -1,0 +1,344 @@
+"""Observability layer: perf-dump layout vs the reference shape, tracer
+nesting + thread safety, counters advancing on real hot-path runs, dout
+line shape, the daemon CLI / admin socket, and the no-print lint."""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ceph_tpu import obs
+from ceph_tpu.obs import trace
+from ceph_tpu.utils import perf_counters as pc
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# -- perf-dump JSON layout (reference perf_counters.h shapes) --------------
+
+def test_perf_dump_layout():
+    L = obs.logger_for("t_layout")
+    L.add_u64("ops", "op count")
+    L.add_avg("batch", "batch size")
+    L.add_time_avg("lat", "latency")
+    L.add_histogram("sz", [10.0, 100.0], "sizes")
+    L.inc("ops", 3)
+    L.observe("batch", 7.0)
+    L.observe("lat", 0.25)
+    for v in (5.0, 50.0, 500.0):
+        L.observe("sz", v)
+
+    d = obs.perf_dump()["t_layout"]
+    # u64: bare integer
+    assert d["ops"] == 3
+    # avg: {avgcount, sum}
+    assert d["batch"] == {"avgcount": 1, "sum": 7.0}
+    # time_avg: {avgcount, sum, avgtime}
+    assert set(d["lat"]) == {"avgcount", "sum", "avgtime"}
+    assert d["lat"]["avgcount"] == 1
+    assert d["lat"]["avgtime"] == pytest.approx(d["lat"]["sum"])
+    # histogram: bounds + one-larger buckets + sum/count
+    h = d["sz"]
+    assert h["bounds"] == [10.0, 100.0]
+    assert h["buckets"] == [1, 1, 1]
+    assert h["count"] == 3 and h["sum"] == pytest.approx(555.0)
+
+
+def test_perf_schema_and_reset_values():
+    L = obs.logger_for("t_schema")
+    L.add_u64("n", "a count")
+    L.inc("n", 9)
+    s = obs.perf_schema()["t_schema"]["n"]
+    assert s == {"type": "u64", "description": "a count"}
+    obs.reset_values()
+    assert obs.perf_dump()["t_schema"]["n"] == 0
+    L.inc("n")  # declarations survive a reset
+    assert obs.perf_dump()["t_schema"]["n"] == 1
+
+
+def test_declaration_idempotent_and_errors():
+    L = obs.logger_for("t_decl")
+    L.add_u64("k", "first")
+    L.inc("k", 5)
+    L.add_u64("k", "again")  # idempotent: value survives
+    assert obs.perf_dump()["t_decl"]["k"] == 5
+
+    with pytest.raises(pc.CounterKindError, match="t_decl.*k"):
+        L.add_avg("k")
+    with pytest.raises(obs.UndeclaredCounterError, match="t_decl.*nope"):
+        L.inc("nope")
+    with pytest.raises(obs.UndeclaredCounterError, match="t_decl.*nope"):
+        L.observe("nope", 1.0)
+    with pytest.raises(pc.CounterKindError):
+        L.observe("k", 1.0)  # u64 needs inc()
+
+
+# -- tracer ----------------------------------------------------------------
+
+@pytest.fixture
+def tracer(tmp_path):
+    prev = trace.trace_path()  # may be set via CEPH_TPU_TRACE in the env
+    path = str(tmp_path / "trace.json")
+    trace.clear()
+    obs.set_trace_path(path)
+    yield path
+    obs.set_trace_path(prev)
+    trace.clear()
+
+
+def test_tracer_disabled_records_nothing():
+    prev = trace.trace_path()  # may be set via CEPH_TPU_TRACE in the env
+    obs.set_trace_path(None)
+    try:
+        n0 = trace.n_events()
+        with obs.span("t.noop"):
+            pass
+        assert trace.n_events() == n0
+    finally:
+        obs.set_trace_path(prev)
+
+
+def test_tracer_nesting(tracer):
+    with obs.span("t.outer", depth=0):
+        with obs.span("t.inner"):
+            pass
+    assert obs.flush() == tracer
+    doc = json.loads(Path(tracer).read_text())
+    ev = {e["name"]: e for e in doc["traceEvents"]}
+    outer, inner = ev["t.outer"], ev["t.inner"]
+    assert outer["ph"] == inner["ph"] == "X"
+    assert outer["tid"] == inner["tid"]
+    # time containment = nesting in the trace-event model
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"depth": 0}
+
+
+def test_tracer_thread_safety(tracer):
+    N_THREADS, N_SPANS = 8, 50
+    # all threads in flight together (pthread ids are reused once a
+    # thread exits, which would collapse the distinct-tid check)
+    gate = threading.Barrier(N_THREADS)
+
+    def work(i):
+        gate.wait()
+        for j in range(N_SPANS):
+            with obs.span(f"t.worker{i}", j=j):
+                pass
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(N_THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert trace.n_events() == N_THREADS * N_SPANS
+    doc = json.loads(Path(obs.flush()).read_text())
+    tids = {e["tid"] for e in doc["traceEvents"]}
+    assert len(tids) == N_THREADS
+
+
+def test_tracer_counter_and_instant(tracer):
+    obs.instant("t.marker", note="x")
+    obs.counter("t.gauge", 3.5)
+    doc = json.loads(Path(obs.flush()).read_text())
+    phases = {e["name"]: e["ph"] for e in doc["traceEvents"]}
+    assert phases == {"t.marker": "i", "t.gauge": "C"}
+
+
+# -- counters advance on real hot-path runs --------------------------------
+
+def test_pipeline_counters_advance():
+    from ceph_tpu.osd.osdmap import build_hierarchical
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+    from ceph_tpu.osd.types import PgPool, PoolType
+
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=64, pgp_num=64)
+    m = build_hierarchical(2, 8, n_rack=1, pool=pool)
+    before = obs.perf_dump()["pipeline"]
+    pm = PoolMapper(m, 0, overlays=False)
+    pm.map_batch(np.arange(64, dtype=np.uint32))
+    after = obs.perf_dump()["pipeline"]
+    assert after["pgs_mapped"] - before["pgs_mapped"] == 64
+    # the jitted fast path went through compile/dispatch accounting
+    assert after["fast_compiles"] >= 1
+    assert after["fast_compile_seconds"]["avgcount"] >= 1
+    assert after["fast_compile_seconds"]["sum"] > 0
+    # the d2h fetch of the unresolved flags is booked
+    assert after["result_fetch_seconds"]["avgcount"] > (
+        before.get("result_fetch_seconds", {"avgcount": 0})["avgcount"]
+        if isinstance(before.get("result_fetch_seconds"), dict) else 0
+    )
+
+
+def test_ec_counters_advance():
+    from ceph_tpu.ec.registry import create_erasure_code
+
+    code = create_erasure_code({"plugin": "jax", "k": "8", "m": "4"})
+    data = np.arange(8 * 4096, dtype=np.uint8).reshape(8, 4096)
+    before = obs.perf_dump()["ec"]
+    enc = code.encode_chunks(data)
+    after = obs.perf_dump()["ec"]
+    assert after["bytes_encoded"] - before["bytes_encoded"] == data.size
+    assert (after["encode_seconds"]["avgcount"]
+            == before["encode_seconds"]["avgcount"] + 1)
+
+    chunks = {i: enc[i] for i in range(12) if i not in (0, 5)}
+    code.decode_chunks({0, 5}, dict(chunks), 4096)
+    after2 = obs.perf_dump()["ec"]
+    assert after2["bytes_decoded"] - after["bytes_decoded"] == 2 * 4096
+
+
+def test_balancer_counters_advance():
+    from ceph_tpu.balancer.upmap import calc_pg_upmaps
+    from ceph_tpu.osd.osdmap import build_hierarchical
+    from ceph_tpu.osd.types import PgPool, PoolType
+
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=256, pgp_num=256)
+    m = build_hierarchical(4, 8, n_rack=1, pool=pool)
+    m.osd_weight[0] = int(0x10000 * 0.5)
+    before = obs.perf_dump()["balancer"]
+    calc_pg_upmaps(m, max_deviation=1, max_iter=5,
+                   rng=np.random.default_rng(7))
+    after = obs.perf_dump()["balancer"]
+    assert after["rounds"] > before["rounds"]
+    assert after["build_state_seconds"]["avgcount"] > (
+        before["build_state_seconds"]["avgcount"])
+
+
+# -- Prometheus text exposition --------------------------------------------
+
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{le=\"[^\"]+\"\})? (-?\d+(\.\d+)?"
+    r"(e[+-]?\d+)?|NaN)$"
+)
+
+
+def test_prometheus_text_valid():
+    L = obs.logger_for("t_prom")
+    L.add_u64("hits", "hit count")
+    L.add_time_avg("lat", "latency")
+    L.add_histogram("sz", [1.0, 10.0], "sizes")
+    L.inc("hits", 2)
+    L.observe("lat", 0.5)
+    L.observe("sz", 5.0)
+    text = obs.prometheus_text()
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_][a-zA-Z0-9_]* ", line)
+        else:
+            assert _METRIC_LINE.match(line), f"bad metric line: {line!r}"
+    assert "ceph_tpu_t_prom_hits 2" in text
+    assert 'ceph_tpu_t_prom_sz_bucket{le="+Inf"} 1' in text
+    assert "ceph_tpu_t_prom_lat_count 1" in text
+
+
+# -- dout line shape + set_output ------------------------------------------
+
+def test_dout_line_shape_and_late_set_output():
+    from ceph_tpu.utils import dout
+
+    log = dout.subsys_logger("t_dout")  # created BEFORE set_output
+    dout.set_subsys_level("t_dout", 5)
+    buf = io.StringIO()
+    dout.set_output(buf)
+    try:
+        log(5, "hello", 42)
+        assert log.enabled(5) and not log.enabled(6)
+    finally:
+        dout.set_output(None)
+    line = buf.getvalue().rstrip("\n")
+    # 2026-08-02T10:11:12.345678+0000 7f3a00c0 5 t_dout: hello 42
+    assert re.match(
+        r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}[+-]\d{4} "
+        r"[0-9a-f]+ +5 t_dout: hello 42$",
+        line,
+    ), f"bad log line: {line!r}"
+
+
+# -- admin socket + daemon CLI ---------------------------------------------
+
+def test_admin_socket_roundtrip(tmp_path):
+    from ceph_tpu.obs import admin_socket
+
+    L = obs.logger_for("t_sock")
+    L.add_u64("n")
+    L.inc("n", 4)
+    srv = admin_socket.start(str(tmp_path / "x.asok"))
+    try:
+        out = admin_socket.client_command(srv.path, "perf dump")
+        assert json.loads(out)["t_sock"]["n"] == 4
+        out = admin_socket.client_command(srv.path, "metrics")
+        assert "ceph_tpu_t_sock_n 4" in out
+        out = admin_socket.client_command(srv.path, "bogus")
+        assert "unknown command" in json.loads(out)["error"]
+    finally:
+        srv.close()
+
+
+def test_handle_command_perf_reset():
+    from ceph_tpu.obs.admin_socket import handle_command
+
+    L = obs.logger_for("t_reset")
+    L.add_u64("n")
+    L.inc("n", 2)
+    assert json.loads(handle_command("perf reset")) == {"ok": True}
+    assert obs.perf_dump()["t_reset"]["n"] == 0
+
+
+@pytest.mark.slow
+def test_daemon_cli_selftest():
+    """`python -m ceph_tpu.cli.daemon perf dump` in a fresh process runs a
+    small mapping + RS encode and prints reference-layout JSON."""
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.cli.daemon", "perf dump"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    d = json.loads(out.stdout)
+    assert d["pipeline"]["pgs_mapped"] > 0
+    assert d["ec"]["bytes_encoded"] > 0
+    assert d["pipeline"]["fast_compile_seconds"]["avgcount"] >= 1
+
+
+# -- hot paths never print to stdout ---------------------------------------
+
+def test_no_print_lint():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_no_print.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_no_print_lint_catches_violation(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_no_print import check_file
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import sys\nprint('a')\nprint('b', file=sys.stdout)\n"
+        "print('c', file=sys.stderr)\n"
+    )
+    v = check_file(bad)
+    assert len(v) == 2  # stderr print is allowed
+
+
+# -- satellite: pytest must not collect TesterConfig -----------------------
+
+def test_tester_config_not_collected():
+    from ceph_tpu.crush.tester import TesterConfig
+
+    assert TesterConfig.__test__ is False

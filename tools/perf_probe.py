@@ -38,6 +38,8 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from ceph_tpu import obs  # noqa: E402  (needs the repo-root sys.path)
+
 
 def log(msg):
     print(f"probe[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -225,11 +227,21 @@ def main():
            "device": str(jax.devices()[0])}
     ks = (1, 4, 16) if args.quick else (1, 2, 4, 8, 16)
     if "scaling" not in skip:
-        res["scaling"] = probe_scaling(m, ks=ks)
+        with obs.span("probe.scaling"):
+            res["scaling"] = probe_scaling(m, ks=ks)
     if "ablations" not in skip:
-        res["ablations"] = probe_ablations(m)
+        with obs.span("probe.ablations"):
+            res["ablations"] = probe_ablations(m)
     if "trace" not in skip:
-        res["trace"] = probe_trace(m)
+        with obs.span("probe.trace"):
+            res["trace"] = probe_trace(m)
+    # the probe drives PoolMapper kernels, so the pipeline perf group has
+    # been advancing; ship it (and the span trace, if CEPH_TPU_TRACE is
+    # set) with the numbers
+    res["perf"] = obs.perf_dump()
+    tp = obs.flush()
+    if tp:
+        res["span_trace"] = tp
     print(json.dumps(res, indent=1))
 
 
