@@ -16,27 +16,39 @@ Measures, on the default jax device (the real TPU chip when present):
    (reference tool: src/test/erasure-code/ceph_erasure_code_benchmark.cc:
    156-317), plus Clay(8,4,d=11) single-chunk repair bandwidth.
 
-Survivability design (this file prints ONE JSON line, always, rc=0):
+Survivability design (this file prints ONE JSON line, always, rc=0),
+built on ceph_tpu.runtime:
 
-- Supervisor/worker split: the measurements run in a child process that
-  flushes each stage's result to BENCH_partial.json as soon as it exists.
-  The parent enforces a wall-clock deadline (BENCH_DEADLINE_S, default
-  540s) and, if the child hangs (e.g. TPU init stall), OOMs, or crashes,
-  kills it and assembles the final JSON from whatever stages completed.
-- If TPU init itself failed/hung, the parent re-runs the worker once on
-  CPU (recorded loudly: backend="cpu", notes include the TPU failure) so
-  a number always exists unless BENCH_REQUIRE_TPU is set.
+- Supervisor/worker split: the measurements run in a child process; the
+  parent enforces a wall-clock deadline (BENCH_DEADLINE_S, default 540s)
+  and, if the child hangs, OOMs, or crashes, kills it and assembles the
+  final JSON from whatever stages checkpointed.
+- The worker acquires its backend through `runtime.acquire_backend()`:
+  `jax.devices()` runs in a watchdogged subprocess probe (a TPU init
+  hang costs BENCH_PROBE_TIMEOUT, not the run), degrades tpu -> cpu down
+  the ladder, and records full provenance (backend, fallback_reason,
+  attempts, init_seconds, diagnosis) into the output JSON.
+  BENCH_REQUIRE_TPU is the hard gate: nonzero = fail instead of degrade.
+- Stages run under `runtime.StageScheduler`: priority-ordered against
+  the deadline, each completed stage checkpointed atomically to
+  BENCH_partial.json.  EC stages outrank mapping configs, and the
+  north-star rebalance stage outranks the slow headline config, so a
+  pathological headline run cannot starve it.  `bench.py --resume` after
+  a mid-run kill skips checkpointed stages and finishes the remainder.
+- `bench.py --selftest`: a <60s CPU-only run that injects a TPU-init
+  hang (runtime.faults) and asserts every stage — including a miniature
+  rebalance — completes with correct provenance.
 - The PG axis is chunked (BENCH_CHUNK, default 65536): peak device memory
   is O(chunk), not O(BENCH_PGS) — the r02 failure mode (XLA OOM
   materializing [N, T, lanes] intermediates at N=1M) cannot recur.
-- EC stages run before the big mapping configs so a mapping failure
-  can't destroy the EC numbers.
 - The JAX persistent compilation cache is enabled; repeat runs skip the
   ~20-40s per-config compiles.
 
 Env knobs: BENCH_PGS, BENCH_OSDS, BENCH_BASELINE_PGS, BENCH_EC_MB,
-BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU (nonzero =
-hard-fail if the configured accelerator cannot initialize), BENCH_SKIP_EC.
+BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU,
+BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
+second mapping config, selftest), plus the CEPH_TPU_FAULTS /
+CEPH_TPU_LADDER / CEPH_TPU_INIT_* runtime knobs.
 """
 
 from __future__ import annotations
@@ -51,13 +63,15 @@ from pathlib import Path
 
 import numpy as np
 
-from ceph_tpu import obs
+from ceph_tpu import obs, runtime
 
 _HERE = Path(__file__).resolve().parent
 sys.path.insert(0, str(_HERE / "tests"))
 
 N_PGS = int(os.environ.get("BENCH_PGS", 1_000_000))
 N_OSDS = int(os.environ.get("BENCH_OSDS", 1024))
+CFG2_PGS = int(os.environ.get("BENCH_CFG2_PGS", 100_000))
+CFG2_OSDS = int(os.environ.get("BENCH_CFG2_OSDS", 1024))
 BASELINE_PGS = int(os.environ.get("BENCH_BASELINE_PGS", 200_000))
 EC_MB = int(os.environ.get("BENCH_EC_MB", 16))
 _CHUNK_ENV = os.environ.get("BENCH_CHUNK", "")  # "" = pipeline default;
@@ -75,66 +89,9 @@ def _log(msg: str) -> None:
 
 
 # ----------------------------------------------------------------- worker
-
-class Stages:
-    """Accumulates stage results; atomically rewrites PARTIAL per flush.
-
-    Every flush embeds the perf registry (latest snapshot top-level, a
-    per-stage snapshot inside each stage record) and rewrites the
-    CEPH_TPU_TRACE file, so a deadline-killed or hung run leaves a full
-    diagnostic record — which counters advanced, where compile seconds
-    went, how many lanes were unresolved — not a one-line note."""
-
-    def __init__(self, path: Path):
-        self.path = path
-        self.data: dict = {"stages_done": []}
-
-    def put(self, name: str, value) -> None:
-        if isinstance(value, dict):
-            value = dict(value, perf=obs.perf_dump())
-        self.data[name] = value
-        self.data["stages_done"].append(name)
-        self.flush()
-        _log(f"stage {name} done")
-
-    def flush(self) -> None:
-        self.data["perf"] = obs.perf_dump()
-        try:
-            # SIGKILL survival: last flush before a kill wins
-            tp = obs.flush()
-            if tp:
-                self.data["trace"] = tp
-        except OSError as e:
-            # a bad CEPH_TPU_TRACE path must not kill the bench (or mask
-            # the stage error that routed through fail() -> flush())
-            self.data["trace_error"] = f"{type(e).__name__}: {e}"[:200]
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(self.data))
-        tmp.replace(self.path)
-
-    def fail(self, name: str, err: Exception) -> None:
-        self.data.setdefault("errors", {})[name] = (
-            f"{type(err).__name__}: {err}"[:300]
-        )
-        self.flush()
-        _log(f"stage {name} FAILED: {type(err).__name__}: {str(err)[:200]}")
-
-
-def _enable_compile_cache() -> None:
-    import jax
-
-    cache = Path(os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                "/root/.cache/jax_bench_cache"))
-    cache.mkdir(parents=True, exist_ok=True)
-    for opt, val in (
-        ("jax_compilation_cache_dir", str(cache)),
-        ("jax_persistent_cache_min_entry_size_bytes", -1),
-        ("jax_persistent_cache_min_compile_time_secs", 0.0),
-    ):
-        try:
-            jax.config.update(opt, val)
-        except Exception:
-            pass
+# Stage checkpointing lives in runtime.Checkpoint (the class this file's
+# old Stages accumulator grew into); the compile-cache pre-warm is
+# runtime.prewarm_compile_cache, run by acquire_backend().
 
 
 def build_map(n_pgs: int, n_osds: int):
@@ -290,7 +247,7 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
 
 
 def bench_rebalance(n_pgs: int, n_osds: int, rounds: int,
-                    remaining, st=None) -> dict:
+                    remaining, handle=None) -> dict:
     """North-star sim (BASELINE config 5): build an n_pgs/n_osds map,
     perturb OSD reweights, then run upmap balancer rounds with per-round
     wall-clock — the reference's `osdmaptool --upmap` loop
@@ -329,9 +286,9 @@ def bench_rebalance(n_pgs: int, n_osds: int, rounds: int,
         total_changed += r.num_changed
         res["total_changed"] = total_changed
         res["upmap_items"] = len(m.pg_upmap_items)
-        if st is not None:  # flush progress: a killed worker keeps rounds
-            st.data["rebalance"] = res
-            st.flush()
+        if handle is not None:  # flush progress: a killed worker keeps
+            handle.progress(res)  # completed rounds (not marked done —
+            # a resume re-runs the stage, never trusts a partial)
         if r.num_changed == 0:
             res["converged"] = True
             break
@@ -453,113 +410,125 @@ def bench_clay() -> dict:
     }
 
 
-def worker() -> None:
-    st = Stages(PARTIAL)
-    t_start = float(os.environ.get("BENCH_T0", time.time()))
+PROBE_TIMEOUT_S = float(os.environ.get(
+    "BENCH_PROBE_TIMEOUT", os.environ.get("BENCH_INIT_TIMEOUT", 120)))
 
-    def remaining() -> float:
-        return DEADLINE_S - (time.time() - t_start)
+# wall-clock the rebalance stage leaves on the table for the headline
+# stage that runs after it (the reverse of the r01-r05 starvation)
+HEADLINE_RESERVE_S = float(os.environ.get("BENCH_HEADLINE_RESERVE", 60))
 
-    # -- init (the parent's watchdog covers a hang here) -----------------
-    # NOTE: the session's sitecustomize pins the platform at interpreter
-    # start, so the JAX_PLATFORMS env var is NOT honored — only
-    # jax.config.update("jax_platforms", ...) reliably selects CPU.
-    t0 = time.time()
-    import jax
 
-    note = None
+def _acquire(ck: runtime.Checkpoint) -> runtime.BackendInfo:
+    """Backend acquisition through the runtime ladder; the provenance
+    record (backend, fallback_reason, attempts, ...) becomes the `init`
+    stage.  Runs even on --resume: a resumed run may land on different
+    hardware, and the output must say which backend produced it."""
+    require = None
+    if os.environ.get("BENCH_REQUIRE_TPU", "0") not in ("", "0"):
+        require = "tpu"
+    ladder = None
     if os.environ.get("BENCH_FORCE_CPU"):
-        jax.config.update("jax_platforms", "cpu")
+        ladder = ["cpu"]
+    else:
+        # no "native" rung here: every stage needs a jax backend, and the
+        # cpu rung only fails when jax itself is broken — fail loudly
+        # then.  cpu stays the terminal rung even if a user ladder ends
+        # in "native" (which filtering would otherwise drop).
+        ladder = [r for r in runtime.default_ladder() if r != "native"]
+        if "cpu" not in ladder:
+            ladder.append("cpu")
     try:
-        devs = jax.devices()
-    except Exception as e:
-        note = f"accelerator init failed: {type(e).__name__}: {e}"[:250]
-        _log(note)
-        if os.environ.get("BENCH_REQUIRE_TPU", "0") not in ("", "0"):
-            raise SystemExit(2)
-        jax.config.update("jax_platforms", "cpu")
-        devs = jax.devices()
-    init = {
-        "backend": jax.default_backend(),
-        "device": str(devs[0]),
-        "n_devices": len(devs),
-        "init_s": round(time.time() - t0, 1),
-    }
-    if note:
-        init["note"] = note
-    st.put("init", init)
-    _enable_compile_cache()
+        info = runtime.acquire_backend(
+            ladder=ladder, require=require, timeout_s=PROBE_TIMEOUT_S,
+            attempts=int(os.environ.get("CEPH_TPU_INIT_ATTEMPTS", 1)),
+            prewarm_cache=True,
+        )
+    except runtime.RequiredBackendError as e:
+        ck.fail("init", e)
+        _log(f"backend acquisition failed: {e}")
+        raise SystemExit(2)
+    prov = info.provenance()
+    prov["init_s"] = round(info.init_seconds, 1)  # legacy key
+    ck.put("init", prov)
+    return info
 
-    # -- EC first: a mapping failure must not destroy these numbers ------
+
+def worker() -> None:
+    ck = runtime.Checkpoint(
+        PARTIAL, resume=bool(os.environ.get("BENCH_RESUME"))
+    )
+    t_start = float(os.environ.get("BENCH_T0", time.time()))
+    sched = runtime.StageScheduler(ck, DEADLINE_S, t0=t_start)
+    _acquire(ck)
+
+    # -- stage declarations; priority order, not source order, runs ------
+    def ec_stage(name, profile):
+        return lambda h: bench_ec_engine(name, profile)
+
     if not os.environ.get("BENCH_SKIP_EC"):
-        for name, profile in (
-            ("jax", {"plugin": "jax", "k": "8", "m": "4"}),
-            ("native", {"plugin": "isa", "k": "8", "m": "4",
-                        "backend": "native"}),
-        ):
-            try:
-                st.put(f"ec_{name}", bench_ec_engine(name, profile))
-            except Exception as e:
-                st.fail(f"ec_{name}", e)
-        try:
-            st.put("ec_clay", bench_clay())
-        except Exception as e:
-            st.fail("ec_clay", e)
+        # EC outranks mapping: a mapping failure can't destroy EC numbers
+        sched.add("ec_jax",
+                  ec_stage("jax", {"plugin": "jax", "k": "8", "m": "4"}),
+                  priority=90, est_s=25, min_budget_s=20)
+        sched.add("ec_native",
+                  ec_stage("native", {"plugin": "isa", "k": "8", "m": "4",
+                                      "backend": "native"}),
+                  priority=88, est_s=10, min_budget_s=10)
+        sched.add("ec_clay", lambda h: bench_clay(),
+                  priority=86, est_s=20, min_budget_s=15)
 
-    # -- mapping configs, small to large ---------------------------------
-    try:
+    def cfg1(h):
         m1 = build_map(1000, 32)
         r = bench_mapping(m1, 1000)
         c1 = bench_c_reference(m1, 100_000)
         if c1:
             r["c_baseline_mps"] = round(c1, 1)
             r["vs_c"] = round(r["mappings_per_sec"] / c1, 3)
-        st.put("crushtool_1k_32", r)
-    except Exception as e:
-        st.fail("crushtool_1k_32", e)
+        return r
 
-    try:
-        m2 = build_map(100_000, 1024)
-        r = bench_mapping(m2, 100_000)
-        c2 = bench_c_reference(m2, min(BASELINE_PGS, 100_000))
+    def cfg2(h):
+        m2 = build_map(CFG2_PGS, CFG2_OSDS)
+        r = bench_mapping(m2, CFG2_PGS)
+        c2 = bench_c_reference(m2, min(BASELINE_PGS, CFG2_PGS))
         if c2:
             r["c_baseline_mps"] = round(c2, 1)
             r["vs_c"] = round(r["mappings_per_sec"] / c2, 3)
-        st.put("testmappgs_100k_1k", r)
-    except Exception as e:
-        st.fail("testmappgs_100k_1k", e)
+        return r
 
-    # -- headline: self-budget against the deadline ----------------------
-    n = N_PGS
-    if remaining() < 90:
-        st.put("headline_skipped", {"remaining_s": round(remaining(), 1)})
-        return
-    if remaining() < 180 and n > 250_000:
-        n = 250_000
-        _log(f"headline reduced to {n} PGs ({remaining():.0f}s left)")
-    try:
+    def rebalance(h):
+        # north-star: 10M-PG / 10k-OSD rebalance sim.  Outranks headline
+        # so a slow headline can never starve it again (r01-r05), but
+        # leaves HEADLINE_RESERVE_S of deadline for headline to run after.
+        ns_pgs = int(os.environ.get("BENCH_NS_PGS", 10_000_000))
+        ns_osds = int(os.environ.get("BENCH_NS_OSDS", 10_000))
+        ns_rounds = int(os.environ.get("BENCH_NS_ROUNDS", 10))
+        return bench_rebalance(
+            ns_pgs, ns_osds, ns_rounds,
+            lambda: h.remaining() - HEADLINE_RESERVE_S, handle=h,
+        )
+
+    def headline(h):
+        n = N_PGS
+        if h.remaining() < 180 and n > 250_000:
+            n = 250_000
+            _log(f"headline reduced to {n} PGs ({h.remaining():.0f}s left)")
         mh = build_map(n, N_OSDS)
         r = bench_mapping(mh, n, reps=max(1, REPS - 1))
         ch = bench_c_reference(mh, BASELINE_PGS)
         if ch:
             r["c_baseline_mps"] = round(ch, 1)
             r["vs_c"] = round(r["mappings_per_sec"] / ch, 3)
-        st.put("headline", r)
-    except Exception as e:
-        st.fail("headline", e)
+        return r
 
-    # -- north-star: 10M-PG / 10k-OSD rebalance sim ----------------------
-    ns_pgs = int(os.environ.get("BENCH_NS_PGS", 10_000_000))
-    ns_osds = int(os.environ.get("BENCH_NS_OSDS", 10_000))
-    ns_rounds = int(os.environ.get("BENCH_NS_ROUNDS", 10))
-    if remaining() < 120:
-        st.put("rebalance_skipped", {"remaining_s": round(remaining(), 1)})
-        return
-    try:
-        r = bench_rebalance(ns_pgs, ns_osds, ns_rounds, remaining, st=st)
-        st.put("rebalance", r)
-    except Exception as e:
-        st.fail("rebalance", e)
+    sched.add("crushtool_1k_32", cfg1, priority=80, est_s=30,
+              min_budget_s=25)
+    sched.add("testmappgs_100k_1k", cfg2, priority=70, est_s=60,
+              min_budget_s=40)
+    sched.add("rebalance", rebalance, priority=60, est_s=150,
+              min_budget_s=100)
+    sched.add("headline", headline, priority=40, est_s=120,
+              min_budget_s=90)
+    sched.run()
 
 
 # -------------------------------------------------------------- supervisor
@@ -591,14 +560,25 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         "value": value,
         "unit": "mappings/s",
         "vs_baseline": vs,
+        # explicit acquisition provenance (runtime.BackendInfo): which
+        # backend produced the number, why it degraded, how hard init was
         "backend": init.get("backend", "none"),
         "device": init.get("device", "none"),
+        "fallback_reason": init.get("fallback_reason"),
+        "attempts": init.get("attempts", 0),
         "init_s": init.get("init_s"),
         "c_baseline_mps": head.get("c_baseline_mps"),
         "configs": configs,
         "ec": ec,
         "elapsed_s": round(elapsed, 1),
     }
+    for key in ("diagnosis", "failures"):
+        if init.get(key):
+            out[key] = init[key]
+    if stages.get("resumed_stages"):
+        out["resumed_stages"] = stages["resumed_stages"]
+    if "stages_done" in stages:
+        out["stages_done"] = list(stages["stages_done"])
     if "rebalance" in stages:
         rb = _strip_perf(stages["rebalance"])
         key = "rebalance"
@@ -667,28 +647,42 @@ def _run_worker(env: dict, deadline: float,
     return None, reason
 
 
-def supervise() -> None:
+def supervise(resume: bool = False) -> None:
     from ceph_tpu.obs import admin_socket
 
     admin_socket.release()  # the worker owns CEPH_TPU_ADMIN_SOCKET
     t0 = time.time()
     notes: list[str] = []
-    PARTIAL.unlink(missing_ok=True)
+    if resume:
+        prev = _read_partial()
+        done = prev.get("stages_done", [])
+        if done:
+            notes.append(f"resumed: {len(done)} stage(s) checkpointed")
+            _log(f"resuming past stages {done}")
+        else:
+            resume = False  # nothing to resume from
+    if not resume:
+        PARTIAL.unlink(missing_ok=True)
     env = dict(os.environ, BENCH_WORKER="1", BENCH_T0=str(t0))
+    if resume:
+        env["BENCH_RESUME"] = "1"
     rc, reason = _run_worker(env, DEADLINE_S, INIT_TIMEOUT_S)
     if reason:
         notes.append(reason)
     stages = _read_partial()
 
-    # accelerator init never completed -> one CPU retry so a number exists
-    if "init" not in stages:
+    # backend acquisition never completed (the runtime ladder itself was
+    # killed, or the worker died first) -> one CPU retry, resuming any
+    # stages that did checkpoint, so a number exists
+    if "init" not in stages.get("stages_done", ()):
         if os.environ.get("BENCH_REQUIRE_TPU", "0") not in ("", "0"):
             print(json.dumps(_assemble(stages, notes, time.time() - t0)))
             raise SystemExit(2)
         left = DEADLINE_S - (time.time() - t0)
         if left > 60:
             _log(f"retrying on CPU ({left:.0f}s left)")
-            env = dict(env, BENCH_FORCE_CPU="1", BENCH_T0=str(time.time()),
+            env = dict(env, BENCH_FORCE_CPU="1", BENCH_RESUME="1",
+                       BENCH_T0=str(time.time()),
                        BENCH_DEADLINE_S=str(left))
             rc, reason = _run_worker(env, left, None)
             if reason:
@@ -697,8 +691,99 @@ def supervise() -> None:
     print(json.dumps(_assemble(stages, notes, time.time() - t0)))
 
 
+# -------------------------------------------------------------- selftest
+
+SELFTEST_ENV = {
+    # miniature workload: every stage runs, CPU-only, ~tens of seconds.
+    # headline and cfg2 share OSD count and chunk so the persistent
+    # compile cache serves headline from cfg2's compile.
+    "BENCH_PGS": "8192", "BENCH_OSDS": "256", "BENCH_CHUNK": "4096",
+    "BENCH_CFG2_PGS": "4096", "BENCH_CFG2_OSDS": "256",
+    "BENCH_BASELINE_PGS": "20000", "BENCH_EC_MB": "2",
+    "BENCH_NS_PGS": "2048", "BENCH_NS_OSDS": "64", "BENCH_NS_ROUNDS": "2",
+    "BENCH_REPS": "1",
+    # generous deadline: the <60s bound comes from the workload being
+    # tiny, not from budget-skipping stages (skips would fail the assert)
+    "BENCH_DEADLINE_S": "240", "BENCH_HEADLINE_RESERVE": "20",
+    # the survivability path under test: the configured-platform probe
+    # hangs; the watchdog kills it in ~2s and the ladder degrades to cpu
+    "CEPH_TPU_FAULTS": "init.auto=hang:600",
+    "CEPH_TPU_LADDER": "auto,cpu",
+    "BENCH_PROBE_TIMEOUT": "2", "CEPH_TPU_INIT_ATTEMPTS": "1",
+    "BENCH_PARTIAL": "BENCH_selftest.json",
+}
+
+SELFTEST_STAGES = (
+    "init", "ec_jax", "ec_clay", "crushtool_1k_32", "testmappgs_100k_1k",
+    "rebalance", "headline",
+)
+
+
+def selftest() -> int:
+    """<60s CPU-only survivability check: inject a TPU-init hang, then
+    require that EVERY stage (including a miniature rebalance) completes
+    and the output carries the degradation provenance.  Exercises probe
+    watchdog -> ladder descent -> scheduler -> checkpoint end to end; a
+    regression in any of those fails this fast instead of blanking the
+    next real benchmark run."""
+    t0 = time.time()
+    env = dict(os.environ)
+    env.pop("BENCH_REQUIRE_TPU", None)
+    env.pop("BENCH_WORKER", None)
+    env.update(SELFTEST_ENV)
+    problems: list[str] = []
+    out: dict = {}
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(Path(__file__).resolve())],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired as e:
+        # the one failure mode that must still produce a verdict JSON:
+        # the survivability path itself regressed into a wedge
+        problems.append(
+            "selftest run wedged past 300s (survivability path "
+            f"regression?): {str(e.stderr)[-300:] if e.stderr else ''}"
+        )
+    else:
+        try:
+            out = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            problems.append(f"no JSON on stdout (rc={proc.returncode}): "
+                            f"{proc.stdout[-200:]!r} {proc.stderr[-300:]!r}")
+    if out:
+        missing = [s for s in SELFTEST_STAGES
+                   if s not in out.get("stages_done", ())]
+        if missing:
+            problems.append(f"stages missing: {missing}")
+        if out.get("backend") != "cpu":
+            problems.append(f"backend={out.get('backend')!r}, wanted cpu")
+        if not out.get("fallback_reason"):
+            problems.append("no fallback_reason despite injected hang")
+        if not out.get("attempts", 0) >= 2:
+            problems.append(f"attempts={out.get('attempts')}, wanted >=2")
+        if not out.get("value", 0) > 0:
+            problems.append("headline value is zero")
+    verdict = {
+        "selftest": "ok" if not problems else "FAIL",
+        "elapsed_s": round(time.time() - t0, 1),
+        "stages_done": out.get("stages_done"),
+        "backend": out.get("backend"),
+        "fallback_reason": out.get("fallback_reason"),
+        "attempts": out.get("attempts"),
+    }
+    if problems:
+        verdict["problems"] = problems
+    print(json.dumps(verdict))
+    (_HERE / env["BENCH_PARTIAL"]).unlink(missing_ok=True)
+    return 0 if not problems else 1
+
+
 if __name__ == "__main__":
+    if "--selftest" in sys.argv:
+        raise SystemExit(selftest())
     if os.environ.get("BENCH_WORKER"):
         worker()
     else:
-        supervise()
+        supervise(resume="--resume" in sys.argv
+                  or bool(os.environ.get("BENCH_RESUME")))

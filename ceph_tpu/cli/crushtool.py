@@ -11,7 +11,7 @@ Covers the reference tool's compile/decompile/build/test surface
                              --show-utilization[-all] --show-mappings
                              --show-bad-mappings --simulate --backend jax|ref]
     crushtool -i map --tree
-    crushtool -i map --reweight-item name w, --remove-item, --add-item ...
+    crushtool -i map --reweight-item name w -o out
 
 Extra (this framework): --backend selects the vmapped TPU kernel (default)
 or the pure-Python host mapper.

@@ -19,6 +19,8 @@ Commands (reference names):
     perf reset    zero every counter, keep declarations
     metrics       Prometheus text exposition (format 0.0.4)
     trace flush   write the Chrome trace-event file (CEPH_TPU_TRACE)
+    runtime       backend-acquisition provenance (ceph_tpu.runtime:
+                  backend, fallback_reason, attempts) + armed faults
     help          command list
 
 The in-process self-test pins JAX to CPU (it is a diagnostic path — it

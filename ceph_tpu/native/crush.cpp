@@ -1,8 +1,8 @@
 // Native batched CRUSH mapper — the C++ host runtime for the placement
 // pipeline.
 //
-// This is an independent implementation written from this framework's own
-// Python semantic oracle (ceph_tpu/crush/mapper_ref.py); it is the
+// This is a port of the reference semantics, written against this
+// framework's Python semantic oracle (ceph_tpu/crush/mapper_ref.py); it is the
 // native-code analogue of the reference's ParallelPGMapper (reference
 // src/osd/OSDMapMapping.h:18-140): a thread pool shards the x (PG) axis and
 // each worker runs the full rule interpreter per input.  Used by the CLIs

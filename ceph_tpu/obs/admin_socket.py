@@ -10,6 +10,8 @@ protocol here:
     client: "perf reset\\n"     server: {"ok": true} (values zeroed)
     client: "metrics\\n"        server: Prometheus text exposition
     client: "trace flush\\n"    server: {"path": <trace file or null>}
+    client: "runtime\\n"        server: backend-acquisition provenance
+                                + armed fault points
     client: "help\\n"           server: command list JSON
 
 Env-gated like tracing: set `CEPH_TPU_ADMIN_SOCKET=/path/x.asok` and any
@@ -34,7 +36,7 @@ _server: "AdminSocket | None" = None
 
 COMMANDS = (
     "perf dump", "perf schema", "perf reset", "metrics", "trace flush",
-    "help",
+    "runtime", "help",
 )
 
 
@@ -57,6 +59,16 @@ def handle_command(cmd: str) -> str:
         return prometheus_text(pc.perf_dump())
     if cmd == "trace flush":
         return json.dumps({"path": trace.flush()})
+    if cmd == "runtime":
+        # backend-acquisition provenance + armed fault points of the
+        # live process (None until something walked the ladder)
+        from ceph_tpu import runtime
+
+        return json.dumps({
+            "provenance": runtime.last_provenance(),
+            "default_ladder": runtime.default_ladder(),
+            "faults_armed": runtime.faults.active(),
+        }, indent=1, sort_keys=True)
     if cmd == "help":
         return json.dumps(list(COMMANDS))
     return json.dumps({"error": f"unknown command {cmd!r}", "help": list(COMMANDS)})

@@ -32,6 +32,7 @@ import numpy as np
 
 from ceph_tpu import obs
 from ceph_tpu.core.intmath import pg_mask_for, stable_mod
+from ceph_tpu.runtime import faults
 from ceph_tpu.core.rjenkins import crush_hash32_2
 from ceph_tpu.crush import mapper_ref
 from ceph_tpu.crush.mapper_jax import RESCUE_PAD, compile_rule
@@ -502,6 +503,22 @@ class PoolMapper:
         # n_real: distinct seeds in a cycle-padded tail block — the
         # counters book real placement work, not pad-lane duplicates
         n = len(ps) if n_real is None else n_real
+        # mid-batch device loss surfaces here (real transport loss raises
+        # from the dispatch below; `map_batch=lost` injects the same
+        # shape) — callers degrade via sim/ClusterSim or the runtime
+        # ladder, so the fault point sits on the dispatch boundary and
+        # real jaxlib transport errors are mapped onto DeviceLostError
+        faults.check("map_batch")
+        try:
+            return self._map_block_inner(ps, n)
+        except Exception as e:
+            if faults.looks_like_device_loss(e):
+                raise faults.DeviceLostError(
+                    f"{type(e).__name__}: {e}"[:200]
+                ) from e
+            raise
+
+    def _map_block_inner(self, ps: np.ndarray, n: int):
         with obs.span("pipeline.map_block", pgs=n):
             *out, flg = self.jitted_fast()(
                 jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)

@@ -30,13 +30,21 @@ PG_AXIS = "pg"
 def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
     """1-D mesh over the first n devices; the PG axis shards over it.
 
+    The backend is acquired through the runtime degradation ladder
+    (ensure_jax_backend -> runtime.acquire_backend), so a dead TPU
+    transport degrades to the virtual-device CPU mesh with provenance —
+    backend, fallback_reason, attempts — recorded in the `runtime` perf
+    group and `runtime.last_provenance()`, which multichip drivers embed
+    in their MULTICHIP JSON.
+
     (The placement workload has a single giant data axis — see SURVEY's
     parallelism inventory; there is no tensor/pipeline dimension to shard,
     so the mesh is 1-D by design.)
     """
+    from ceph_tpu import obs, runtime
     from ceph_tpu.utils import ensure_jax_backend
 
-    ensure_jax_backend()
+    backend = ensure_jax_backend()
     devs = jax.devices()
     if n_devices is None:
         n_devices = len(devs)
@@ -45,6 +53,9 @@ def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
             f"need {n_devices} devices, have {len(devs)} "
             "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
         )
+    prov = runtime.last_provenance() or {}
+    obs.instant("sharded.make_mesh", backend=backend, devices=n_devices,
+                fallback_reason=prov.get("fallback_reason"))
     return Mesh(np.array(devs[:n_devices]), (axis,))
 
 

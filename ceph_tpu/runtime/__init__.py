@@ -1,0 +1,70 @@
+"""Runtime robustness layer — how every entry point gets a backend.
+
+The r01–r05 benchmark history is a catalogue of runs killed by the
+runtime, not the math: TPU init hanging 90–240 s, stage-deadline
+starvation leaving the north-star rebalance stage blank, a wedged chip
+taking the whole process with it.  This package is the survivability
+layer those runs lacked:
+
+    from ceph_tpu import runtime
+
+    info = runtime.acquire_backend()        # preflight + degradation
+    info.provenance()                       # -> BENCH/MULTICHIP JSON
+
+- `preflight` — watchdogged subprocess probe of `jax.devices()` (a hang
+  costs the timeout, not the run), failure diagnosis (stale chip-holding
+  process, libtpu lockfile, transport env), compile-cache pre-warm.
+- `ladder` — the tpu → cpu → native degradation policy with bounded
+  retries, exponential backoff + jitter, and full provenance (backend,
+  fallback_reason, attempts, init_seconds) recorded in perf counters.
+- `scheduler` — deadline-budgeted priority stage scheduler with atomic
+  checkpoint/resume (the BENCH_partial.json shape) and per-stage
+  watchdogs.
+- `faults` — deterministic fault injection (CEPH_TPU_FAULTS) so every
+  retry/backoff/degradation/resume path runs in fast CPU-only tests.
+
+Importing this package is cheap: no jax import until a probe runs.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.runtime import faults
+from ceph_tpu.runtime.faults import DeviceLostError, FaultInjected
+from ceph_tpu.runtime.ladder import (
+    BackendInfo,
+    RequiredBackendError,
+    acquire_backend,
+    default_ladder,
+    last_provenance,
+)
+from ceph_tpu.runtime.preflight import (
+    ProbeResult,
+    diagnose_init_failure,
+    prewarm_compile_cache,
+    probe,
+)
+from ceph_tpu.runtime.scheduler import (
+    Checkpoint,
+    Stage,
+    StageHandle,
+    StageScheduler,
+)
+
+__all__ = [
+    "BackendInfo",
+    "Checkpoint",
+    "DeviceLostError",
+    "FaultInjected",
+    "ProbeResult",
+    "RequiredBackendError",
+    "Stage",
+    "StageHandle",
+    "StageScheduler",
+    "acquire_backend",
+    "default_ladder",
+    "diagnose_init_failure",
+    "faults",
+    "last_provenance",
+    "prewarm_compile_cache",
+    "probe",
+]

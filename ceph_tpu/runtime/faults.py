@@ -1,0 +1,201 @@
+"""Deterministic fault injection for the runtime robustness layer.
+
+The reference exercises its failure paths with the qa thrasher
+(reference qa/tasks/ceph_manager.py:185 OSDThrasher) — randomized kills
+against a live cluster.  That style lives in `sim/failure.py`; this
+module is the *deterministic* counterpart for the runtime layer itself:
+named fault points compiled into the acquisition / dispatch / scheduler
+paths, armed by env var or API, so every retry / backoff / degradation /
+resume branch runs in fast CPU-only tests instead of waiting for a real
+TPU to wedge.
+
+Spec syntax (env `CEPH_TPU_FAULTS`, comma-separated):
+
+    point[.qualifier]=action[:arg][xN]
+
+    CEPH_TPU_FAULTS="init.tpu=hang:600"        # TPU init hangs 600s
+    CEPH_TPU_FAULTS="init.tpu=fail:ENOLINK x2" # first 2 probes raise
+    CEPH_TPU_FAULTS="compile=stall:3"          # compile stalls 3s
+    CEPH_TPU_FAULTS="map_batch=lost x1"        # device loss, once
+    CEPH_TPU_FAULTS="stage_end.ec_jax=exit:3"  # die after a checkpoint
+    CEPH_TPU_FAULTS="stage.headline=overrun:9" # stage overruns 9s
+
+Actions:
+
+    hang:<secs>   sleep that long (watchdogs are expected to fire first)
+    stall:<secs>  sleep that long, then continue (compile-stall shape)
+    fail[:why]    raise FaultInjected(why)
+    lost[:why]    raise DeviceLostError(why) — the mid-stage device-loss
+                  shape callers degrade from
+    exit[:code]   os._exit(code) — a SIGKILL-grade death (no atexit, no
+                  finally) for checkpoint/resume tests
+    overrun:<s>   sleep — used at stage fault points to trip the stage
+                  watchdog deterministically
+
+`xN` arms the fault for the first N hits only (default: every hit).
+Counts decrement in-process; a respawned worker re-arms from the env,
+which is exactly what the retry-until-healthy tests want.
+
+Fault points are cheap when disarmed: one dict lookup against a dict
+that is empty in production.  Every firing is recorded in the `runtime`
+perf-counter group and as an `obs` instant event, so an armed fault can
+never silently shape a benchmark number.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ceph_tpu.utils.dout import subsys_logger
+
+ENV_VAR = "CEPH_TPU_FAULTS"
+
+_log = subsys_logger("runtime")
+_lock = threading.Lock()
+
+
+class FaultInjected(RuntimeError):
+    """An armed `fail` fault point fired."""
+
+
+class DeviceLostError(RuntimeError):
+    """The device disappeared mid-operation (real transport loss raises
+    jaxlib errors; the injected shape raises this so callers can degrade
+    without pattern-matching vendor exception text)."""
+
+
+# substrings of real jaxlib/XLA transport-loss messages; dispatch sites
+# use looks_like_device_loss() to map them onto DeviceLostError so real
+# losses take the same degradation path the injected ones test
+_DEVICE_LOSS_MARKERS = (
+    "device lost", "data loss", "unavailable", "transport",
+    "socket closed", "connection reset", "device halted", "chip reboot",
+)
+
+
+def looks_like_device_loss(exc: BaseException) -> bool:
+    """True when a raised exception is plausibly the device dying under
+    us (vs. a bug in our code): a jaxlib/XLA runtime error whose message
+    matches a known transport-loss shape."""
+    if isinstance(exc, DeviceLostError):
+        return True
+    mod = type(exc).__module__ or ""
+    if not (mod.startswith("jaxlib") or mod.startswith("jax")
+            or type(exc).__name__ == "XlaRuntimeError"):
+        return False
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+class _Fault:
+    __slots__ = ("action", "arg", "remaining")
+
+    def __init__(self, action: str, arg: str, remaining: int):
+        self.action = action
+        self.arg = arg
+        self.remaining = remaining  # <0 = unlimited
+
+
+_armed: dict[str, _Fault] = {}
+
+
+def _parse_one(item: str) -> tuple[str, _Fault]:
+    point, _, act = item.partition("=")
+    point, act = point.strip(), act.strip()
+    if not point or not act:
+        raise ValueError(f"bad fault spec item {item!r}")
+    remaining = -1
+    if "x" in act:
+        head, _, cnt = act.rpartition("x")
+        if cnt.strip().isdigit():
+            act, remaining = head.strip(), int(cnt)
+    action, _, arg = act.partition(":")
+    action = action.strip()
+    if action not in ("hang", "stall", "fail", "lost", "exit", "overrun"):
+        raise ValueError(f"unknown fault action {action!r} in {item!r}")
+    return point, _Fault(action, arg.strip(), remaining)
+
+
+def configure(spec: str | None) -> None:
+    """Replace the armed-fault table from a spec string ("" or None
+    disarms everything)."""
+    with _lock:
+        _armed.clear()
+        for item in (spec or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            point, f = _parse_one(item)
+            _armed[point] = f
+
+
+def arm(point: str, action: str, arg: str = "", count: int = -1) -> None:
+    """API-side arming (tests that do not want to mutate the env)."""
+    with _lock:
+        _armed[point] = _Fault(action, arg, count)
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+
+
+def _take(point: str, qual: str | None) -> tuple[str, _Fault] | None:
+    """Find the most specific armed fault for point[.qual] and consume
+    one firing from its budget."""
+    with _lock:
+        for key in ((f"{point}.{qual}",) if qual else ()) + (point,):
+            f = _armed.get(key)
+            if f is None or f.remaining == 0:
+                continue
+            if f.remaining > 0:
+                f.remaining -= 1
+            return key, f
+    return None
+
+
+def check(point: str, qual: str | None = None) -> None:
+    """Execute the fault point.  No-op unless a matching fault is armed."""
+    hit = _take(point, qual)
+    if hit is None:
+        return
+    key, f = hit
+    from ceph_tpu import obs
+
+    _rt_counters().inc("faults_fired")
+    obs.instant("fault.fired", point=key, action=f.action)
+    _log(1, f"fault point {key} fired: {f.action}:{f.arg}")
+    if f.action in ("hang", "stall", "overrun"):
+        time.sleep(float(f.arg or 1.0))
+    elif f.action == "fail":
+        raise FaultInjected(f.arg or f"injected failure at {key}")
+    elif f.action == "lost":
+        raise DeviceLostError(f.arg or f"injected device loss at {key}")
+    elif f.action == "exit":
+        os._exit(int(f.arg or 1))
+
+
+def active() -> dict[str, str]:
+    """The armed table, for provenance records ({point: "action:arg"})."""
+    with _lock:
+        return {
+            k: f"{f.action}:{f.arg}" + (f" x{f.remaining}"
+                                        if f.remaining >= 0 else "")
+            for k, f in _armed.items()
+        }
+
+
+def _rt_counters():
+    from ceph_tpu import obs
+
+    L = obs.logger_for("runtime")
+    L.add_u64("faults_fired", "armed fault points that fired")
+    return L
+
+
+# arm from the environment at import: worker subprocesses inherit the
+# spec without any plumbing, which is how bench.py's supervisor/worker
+# pair and the preflight probe child all see the same faults
+configure(os.environ.get(ENV_VAR))

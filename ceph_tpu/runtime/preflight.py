@@ -1,0 +1,318 @@
+"""Device preflight: probe an accelerator platform without betting the
+process on it.
+
+The failure mode this exists for (BENCH r01-r05): `jax.devices()` on the
+TPU plugin blocks for 90-240 s — or forever — when the transport is down
+or a stale process still holds the chip.  An in-process call cannot be
+cancelled, so the probe runs `jax.devices()` in a *subprocess* under a
+watchdog: a hang costs exactly the configured timeout, a crash costs an
+exit code, and the parent process stays healthy either way.
+
+    res = probe("auto", timeout_s=120)   # watchdogged subprocess probe
+    res = probe("cpu", watchdog=False)   # in-process (library fast path)
+
+`platform="auto"` probes whatever the session has configured (env pin /
+sitecustomize) — the hang-prone path; any other name is forced via
+`jax.config.update("jax_platforms", ...)`, the only override that works
+once a sitecustomize has imported jax.
+
+On failure, `diagnose_init_failure()` gathers best-effort evidence of
+*why* — a stale chip-holding process (/dev/accel*, /dev/vfio held by
+another pid), a leftover libtpu lockfile, the transport env — so the
+provenance record says "chip held by pid 1234 (python3)" instead of
+"timeout".
+
+`prewarm_compile_cache()` is the persistent-compile-cache hook
+(previously private to bench.py): enabling it right after acquisition
+means every later jit in the process (bench stages, CLI batch calls)
+hits the on-disk cache.
+
+Fault points (runtime.faults): `init[.platform]` fires in the probe
+child *before* the jax import — an injected hang is cheap to kill — and
+in the in-process path right before `jax.devices()`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ceph_tpu.runtime import faults
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("runtime")
+
+DEFAULT_TIMEOUT_S = float(os.environ.get("CEPH_TPU_INIT_TIMEOUT", 120))
+
+# device nodes an accelerator process holds open; a stale holder is the
+# classic "init hangs until the old run is killed" cause
+_CHIP_DEVICE_PREFIXES = ("/dev/accel", "/dev/vfio", "/dev/apex")
+_LIBTPU_LOCKFILE = "/tmp/libtpu_lockfile"
+
+
+@dataclass
+class ProbeResult:
+    ok: bool
+    platform: str  # requested rung ("auto", "cpu", "tpu", ...)
+    backend: str = ""  # what jax actually reports on success
+    device: str = ""
+    n_devices: int = 0
+    init_s: float = 0.0
+    error: str = ""  # failure reason ("" on success)
+    timed_out: bool = False
+    diagnosis: list[str] = field(default_factory=list)
+
+
+def _chip_holders() -> list[str]:
+    """Best-effort scan for live processes holding an accelerator device
+    node open (requires /proc; never raises)."""
+    holders = []
+    try:
+        for pid_dir in Path("/proc").iterdir():
+            if not pid_dir.name.isdigit() or int(pid_dir.name) == os.getpid():
+                continue
+            fd_dir = pid_dir / "fd"
+            try:
+                for fd in fd_dir.iterdir():
+                    tgt = os.readlink(fd)
+                    if tgt.startswith(_CHIP_DEVICE_PREFIXES):
+                        comm = (pid_dir / "comm").read_text().strip()
+                        holders.append(
+                            f"chip device {tgt} held by pid "
+                            f"{pid_dir.name} ({comm})"
+                        )
+                        break
+            except OSError:
+                continue  # permission / raced exit
+    except OSError:
+        pass
+    return holders
+
+
+def diagnose_init_failure(platform: str) -> list[str]:
+    """Why might accelerator init have failed/hung?  Returns human-readable
+    findings (possibly empty); pure observation, never raises."""
+    finds = _chip_holders()
+    try:
+        if os.path.exists(_LIBTPU_LOCKFILE):
+            finds.append(f"libtpu lockfile present: {_LIBTPU_LOCKFILE}")
+    except OSError:
+        pass
+    for var in ("TPU_NAME", "TPU_WORKER_ID", "JAX_PLATFORMS"):
+        val = os.environ.get(var)
+        if val:
+            finds.append(f"env {var}={val}")
+    if not finds:
+        finds.append(f"no local cause found for platform={platform!r} "
+                     "(transport down?)")
+    return finds
+
+
+def prewarm_compile_cache(cache_dir: str | None = None) -> str | None:
+    """Enable the JAX persistent compilation cache (idempotent); returns
+    the cache dir, or None when jax refuses every knob."""
+    import jax
+
+    cache = Path(
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                          "/root/.cache/jax_bench_cache")
+    )
+    try:
+        cache.mkdir(parents=True, exist_ok=True)
+    except OSError as e:
+        _log(1, f"compile cache dir unavailable: {e}")
+        return None
+    took = False
+    for opt, val in (
+        ("jax_compilation_cache_dir", str(cache)),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ):
+        try:
+            jax.config.update(opt, val)
+            took = True
+        except Exception:
+            pass  # older jax: knob absent; cache simply stays off
+    return str(cache) if took else None
+
+
+# ------------------------------------------------------------------ probes
+
+def _probe_inprocess(platform: str) -> ProbeResult:
+    t0 = time.perf_counter()
+    try:
+        faults.check("init", qual=platform)
+        import jax
+
+        if platform != "auto":
+            jax.config.update("jax_platforms", platform)
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        devs = jax.devices()
+        return ProbeResult(
+            ok=True, platform=platform, backend=jax.default_backend(),
+            device=str(devs[0]), n_devices=len(devs),
+            init_s=time.perf_counter() - t0,
+        )
+    except Exception as e:  # RuntimeError from jax, FaultInjected, ...
+        return ProbeResult(
+            ok=False, platform=platform,
+            error=f"{type(e).__name__}: {e}"[:250],
+            init_s=time.perf_counter() - t0,
+        )
+
+
+# interpreter start + jax import in the probe child is real work, not a
+# hang — it gets its own grace period so timeout_s can stay tight around
+# the thing that actually wedges (device init)
+IMPORT_GRACE_S = float(os.environ.get("CEPH_TPU_IMPORT_GRACE", 60))
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except OSError:
+        proc.kill()
+    proc.wait()
+
+
+def _probe_subprocess(platform: str, timeout_s: float) -> ProbeResult:
+    """Watchdogged two-phase probe.  The child prints an "imported"
+    marker once jax is loaded, then runs `jax.devices()` and prints the
+    result; the parent allows IMPORT_GRACE_S to reach the marker and
+    timeout_s from the marker to the result, killing the whole process
+    group when either budget runs out.  So timeout_s bounds *device
+    init* — the phase that actually hangs — not interpreter startup."""
+    import select
+
+    t0 = time.perf_counter()
+    # the parent may import ceph_tpu off sys.path (repo checkout, not an
+    # installed package) — the child must find it the same way
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH")) if p
+    )
+    # stderr goes to a spooled file, not a pipe: a chatty init (verbose
+    # libtpu/absl logging) would fill a pipe buffer and block the child
+    # mid-init — which this watchdog would then misreport as a hang
+    import tempfile
+
+    errf = tempfile.TemporaryFile()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.runtime.preflight", platform],
+        stdout=subprocess.PIPE, stderr=errf, env=env,
+        start_new_session=True,  # kill the group: libtpu forks helpers
+    )
+    info: dict = {}
+    imported = False
+    deadline = time.monotonic() + IMPORT_GRACE_S
+    timed_out = False
+    while True:
+        wait = deadline - time.monotonic()
+        if wait <= 0:
+            timed_out = True
+            _kill_group(proc)
+            break
+        r, _, _ = select.select([proc.stdout], [], [], min(wait, 0.25))
+        if r:
+            line = proc.stdout.readline()
+            if not line:  # EOF: child finished (or died)
+                proc.wait()
+                break
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("phase") == "imported":
+                imported = True
+                deadline = time.monotonic() + timeout_s
+            else:
+                info = msg
+        elif proc.poll() is not None:
+            break
+    init_s = time.perf_counter() - t0
+    if timed_out:
+        res = ProbeResult(
+            ok=False, platform=platform, timed_out=True,
+            error=(f"device init hung > {timeout_s:g}s "
+                   "(watchdog killed probe)" if imported else
+                   f"probe never loaded jax within {IMPORT_GRACE_S:g}s"),
+            init_s=init_s,
+        )
+    elif proc.returncode == 0 and info:
+        res = ProbeResult(
+            ok=True, platform=platform,
+            backend=info.get("backend", ""),
+            device=info.get("device", ""),
+            n_devices=int(info.get("n_devices", 0)),
+            init_s=init_s,
+        )
+    else:
+        try:
+            errf.seek(0)
+            err = errf.read()
+        except OSError:
+            err = b""
+        tail = err.decode(errors="replace").strip().splitlines()[-3:]
+        res = ProbeResult(
+            ok=False, platform=platform,
+            error=(f"probe exited rc={proc.returncode}: "
+                   + " | ".join(tail))[:300],
+            init_s=init_s,
+        )
+    if proc.stdout:
+        proc.stdout.close()
+    errf.close()
+    if not res.ok:
+        res.diagnosis = diagnose_init_failure(platform)
+    return res
+
+
+def probe(platform: str, timeout_s: float = DEFAULT_TIMEOUT_S,
+          watchdog: bool = True) -> ProbeResult:
+    """Check that `platform` can initialize.  watchdog=True runs the
+    check in a killable subprocess (entry points); watchdog=False runs it
+    in-process (library fast path — cannot be cancelled, but also cannot
+    desync this process's jax config from the verdict)."""
+    from ceph_tpu import obs
+
+    with obs.span("runtime.probe", platform=platform, watchdog=watchdog):
+        if watchdog:
+            return _probe_subprocess(platform, timeout_s)
+        return _probe_inprocess(platform)
+
+
+def _child_main(platform: str) -> int:
+    """Probe-child entry (`python -m ceph_tpu.runtime.preflight <rung>`).
+
+    Prints the "imported" marker once jax is loaded (arming the parent's
+    tight device-init watchdog), then runs the `init` fault point and
+    `jax.devices()` — so an injected hang sits exactly where the real
+    one does and is killed in ~timeout_s."""
+    t0 = time.perf_counter()
+    import jax
+
+    print(json.dumps({"phase": "imported"}), flush=True)
+    if platform != "auto":
+        jax.config.update("jax_platforms", platform)
+    faults.check("init", qual=platform)
+    devs = jax.devices()
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "device": str(devs[0]),
+        "n_devices": len(devs),
+        "init_s": round(time.perf_counter() - t0, 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_child_main(sys.argv[1] if len(sys.argv) > 1 else "auto"))

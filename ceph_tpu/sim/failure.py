@@ -11,6 +11,15 @@ For a placement framework, that means failure simulation = flip osd state,
 re-run the batched mapping, and diff — this module does exactly that, plus
 an OSDThrasher-style randomized fault injector (the qa harness pattern,
 reference qa/tasks/ceph_manager.py:185) used by the tests.
+
+Degraded-mode placement: the device backend itself can die mid-batch
+(transport loss; `runtime.faults` injects the same shape at the
+`map_batch` fault point).  When it does, the sim degrades that mapping
+pass to the host reference mapper — which produces *identical* mappings
+by the bit-exactness contract — and records the descent in the `runtime`
+perf group and `ClusterSim.fallback_events`, so a thrash run that
+silently lost its accelerator still reports which backend actually
+produced each epoch's placements.
 """
 
 from __future__ import annotations
@@ -22,6 +31,10 @@ import numpy as np
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.types import PgId
+from ceph_tpu.runtime import DeviceLostError
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("sim")
 
 
 @dataclass
@@ -45,26 +58,55 @@ class MovementReport:
             self.moved_fraction = self.pgs_remapped / self.total_pgs
 
 
-def _map_all(m: OSDMap, backend: str) -> dict[int, tuple]:
+def _map_ref(m: OSDMap, pid: int) -> tuple:
+    """Host reference mapper for one pool (the degradation target)."""
+    pool = m.pools[pid]
+    n, W = pool.pg_num, pool.size
+    up = np.full((n, W), ITEM_NONE, np.int32)
+    upp = np.full(n, -1, np.int32)
+    acting = np.full((n, W), ITEM_NONE, np.int32)
+    actp = np.full(n, -1, np.int32)
+    for ps in range(n):
+        u, up_pr, a, a_pr = m.pg_to_up_acting_osds(PgId(pid, ps))
+        up[ps, : len(u)] = u
+        acting[ps, : len(a)] = a
+        upp[ps], actp[ps] = up_pr, a_pr
+    return (up, upp, acting, actp)
+
+
+def _device_loss_counter():
+    from ceph_tpu import obs
+
+    L = obs.logger_for("runtime")
+    L.add_u64("device_loss_fallbacks",
+              "mapping passes degraded to the host mapper after a "
+              "mid-batch device loss")
+    return L
+
+
+def _map_all(
+    m: OSDMap, backend: str, events: list[str] | None = None
+) -> dict[int, tuple]:
     out = {}
     for pid in sorted(m.pools):
         if backend == "jax":
             from ceph_tpu.osd.pipeline_jax import PoolMapper
 
-            out[pid] = PoolMapper(m, pid).map_all()
-        else:
-            pool = m.pools[pid]
-            n, W = pool.pg_num, pool.size
-            up = np.full((n, W), ITEM_NONE, np.int32)
-            upp = np.full(n, -1, np.int32)
-            acting = np.full((n, W), ITEM_NONE, np.int32)
-            actp = np.full(n, -1, np.int32)
-            for ps in range(n):
-                u, up_pr, a, a_pr = m.pg_to_up_acting_osds(PgId(pid, ps))
-                up[ps, : len(u)] = u
-                acting[ps, : len(a)] = a
-                upp[ps], actp[ps] = up_pr, a_pr
-            out[pid] = (up, upp, acting, actp)
+            try:
+                out[pid] = PoolMapper(m, pid).map_all()
+                continue
+            except DeviceLostError as e:
+                # degrade, don't die: the host mapper is bit-exact with
+                # the device pipeline, so placements are identical —
+                # only slower.  Record the descent loudly.
+                _device_loss_counter().inc("device_loss_fallbacks")
+                _log(1, f"device lost mapping pool {pid} ({e}); "
+                        "degrading to host mapper")
+                if events is not None:
+                    events.append(
+                        f"pool {pid} epoch {m.epoch}: {e} -> ref"
+                    )
+        out[pid] = _map_ref(m, pid)
     return out
 
 
@@ -99,13 +141,24 @@ class ClusterSim:
         self.m = m
         self.backend = backend
         self.epoch = m.epoch
-        self.current = _map_all(m, backend)
+        # provenance of degraded mapping passes (device loss -> ref)
+        self.fallback_events: list[str] = []
+        self.current = _map_all(m, backend, self.fallback_events)
         self.history: list[tuple[str, MovementReport]] = []
+
+    def provenance(self) -> dict:
+        """Which backend produced the placements, and every degradation
+        that happened along the way."""
+        return {
+            "backend": self.backend,
+            "device_loss_fallbacks": len(self.fallback_events),
+            "fallback_events": list(self.fallback_events),
+        }
 
     def _step(self, label: str) -> MovementReport:
         self.epoch += 1
         self.m.epoch = self.epoch
-        new = _map_all(self.m, self.backend)
+        new = _map_all(self.m, self.backend, self.fallback_events)
         rep = diff_mappings(self.current, new, self.m.pools)
         self.current = new
         self.history.append((label, rep))

@@ -35,6 +35,7 @@ SUBSYS_DEFAULTS = {
     "native": 1,
     "sim": 1,
     "obs": 1,
+    "runtime": 1,
 }
 
 _levels = dict(SUBSYS_DEFAULTS)

@@ -2,10 +2,15 @@
 
 The session's JAX may be pinned (via env) to an accelerator plugin whose
 transport is unavailable (e.g. the TPU tunnel is down).  Library code and
-CLIs call `ensure_jax_backend()` before the first device op: if the
-configured platform fails to initialize, fall back to CPU with a warning
-instead of crashing — every kernel here runs correctly (just slower) on the
-host backend.
+CLIs call `ensure_jax_backend()` before the first device op; it routes
+through the runtime degradation ladder (ceph_tpu.runtime): probe the
+configured platform in-process, fall back to CPU with a warning instead
+of crashing — every kernel here runs correctly (just slower) on the host
+backend.  Entry points that can afford a subprocess watchdog (bench.py,
+long-running CLIs) call `runtime.acquire_backend()` directly; this is
+the cheap cached in-process path for library internals, and its
+provenance still lands in `runtime.last_provenance()` and the `runtime`
+perf-counter group.
 """
 
 from __future__ import annotations
@@ -16,29 +21,37 @@ _checked: str | None = None
 
 
 def ensure_jax_backend() -> str:
-    """Return the usable jax backend name, falling back to CPU if the
-    configured platform cannot initialize."""
+    """Return the usable jax backend name, falling back down the runtime
+    ladder (configured platform -> cpu) if the configured platform cannot
+    initialize.  Cached: the ladder walk happens once per process."""
     global _checked
     if _checked is not None:
         return _checked
-    import jax
+    from ceph_tpu import runtime
 
-    # x64 is load-bearing (s64 straw2 draws, u64 ln math): another library
-    # may have imported jax after mutating the env, or flipped the flag —
-    # a silent 32-bit downcast would produce wrong placements, so force it.
-    if not jax.config.jax_enable_x64:
-        jax.config.update("jax_enable_x64", True)
-    try:
-        jax.devices()
-        _checked = jax.default_backend()
-    except RuntimeError as e:
+    # in-process (watchdog=False): library code must not fork, and an
+    # in-process probe cannot desync this process's jax config from the
+    # verdict.  x64 enforcement (load-bearing: s64 straw2 draws, u64 ln
+    # math) lives in the probe/activation path.
+    # jax-only ladder: drop the jax-free "native" rung, but keep this
+    # module's contract — fall back to CPU, never raise — by ensuring
+    # cpu is still the terminal rung after filtering (a user ladder like
+    # "tpu,native" would otherwise filter down to just "tpu")
+    ladder = [r for r in runtime.default_ladder() if r != "native"]
+    if "cpu" not in ladder:
+        ladder.append("cpu")
+    # attempts=1: an in-process init failure is a plugin RuntimeError,
+    # not a transient transport flake — degrade immediately, as the
+    # pre-runtime guard always did
+    info = runtime.acquire_backend(ladder=ladder, watchdog=False,
+                                   attempts=1)
+    if info.fallback_reason:
         warnings.warn(
-            f"configured jax platform unavailable ({e}); "
-            "falling back to CPU",
+            f"configured jax platform unavailable "
+            f"({info.fallback_reason}); falling back to "
+            f"{info.backend}",
             RuntimeWarning,
             stacklevel=2,
         )
-        jax.config.update("jax_platforms", "cpu")
-        jax.devices()
-        _checked = "cpu"
+    _checked = info.backend
     return _checked

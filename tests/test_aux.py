@@ -1,5 +1,6 @@
-"""Aux subsystem tests: failure/recovery sim + thrasher, perf counters,
-config layering, leveled logging (SURVEY §5 coverage)."""
+"""Aux subsystem tests: failure/recovery sim + thrasher (including
+degraded-mode placement through the runtime fault points), perf
+counters, config layering, leveled logging (SURVEY §5 coverage)."""
 
 import io
 import json
@@ -10,6 +11,8 @@ import pytest
 from ceph_tpu.osd.osdmap import build_hierarchical
 from ceph_tpu.osd.types import PgPool, PoolType
 from ceph_tpu.sim import ClusterSim
+
+pytestmark = pytest.mark.smoke
 
 
 def _map(pg_num=128):
@@ -58,6 +61,58 @@ class TestClusterSim:
         from ceph_tpu.crush.types import ITEM_NONE
 
         for ps in range(64):
+            assert any(o != ITEM_NONE for o in up[ps]), ps
+
+    def test_device_loss_degrades_to_identical_mappings(self):
+        """Runtime fault point `map_batch`: device loss mid-batch must
+        degrade that mapping pass to the host mapper, produce IDENTICAL
+        placements (the bit-exactness contract), and record provenance
+        (ClusterSim.fallback_events + runtime perf counter)."""
+        from ceph_tpu import obs
+        from ceph_tpu.runtime import faults
+
+        m_jax, m_ref = _map(pg_num=32), _map(pg_num=32)
+        sim = ClusterSim(m_jax, backend="jax")  # healthy jax baseline
+        oracle = ClusterSim(m_ref, backend="ref")
+        before = obs.perf_dump().get("runtime", {}).get(
+            "device_loss_fallbacks", 0)
+        faults.arm("map_batch", "lost", "injected transport loss", 1)
+        try:
+            rep = sim.fail_osd(5)
+        finally:
+            faults.disarm_all()
+        rep_ref = oracle.fail_osd(5)
+        # degraded pass == healthy host pass, PG for PG
+        for j in range(4):
+            assert np.array_equal(sim.current[0][j], oracle.current[0][j])
+        assert rep.pgs_remapped == rep_ref.pgs_remapped
+        assert rep.moved_fraction == rep_ref.moved_fraction
+        # the descent was recorded, not silent
+        assert len(sim.fallback_events) == 1
+        assert "injected transport loss" in sim.fallback_events[0]
+        prov = sim.provenance()
+        assert prov["backend"] == "jax"
+        assert prov["device_loss_fallbacks"] == 1
+        after = obs.perf_dump()["runtime"]["device_loss_fallbacks"]
+        assert after == before + 1
+
+    def test_thrasher_through_device_loss_stays_mapped(self):
+        """OSDThrasher + injected device losses: every revive/fail epoch
+        that loses the device degrades and the cluster never unmaps."""
+        from ceph_tpu.crush.types import ITEM_NONE
+        from ceph_tpu.runtime import faults
+
+        m = _map(pg_num=32)
+        sim = ClusterSim(m, backend="jax")
+        faults.arm("map_batch", "lost", "thrash-loss", 2)
+        try:
+            reports = sim.thrash(3, rng=np.random.default_rng(7))
+        finally:
+            faults.disarm_all()
+        assert len(reports) == 3
+        assert len(sim.fallback_events) == 2  # both losses degraded
+        up, _, _, _ = sim.current[0]
+        for ps in range(32):
             assert any(o != ITEM_NONE for o in up[ps]), ps
 
     def test_pg_temp_overrides_acting(self):
