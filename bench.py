@@ -35,20 +35,26 @@ built on ceph_tpu.runtime:
   north-star rebalance stage outranks the slow headline config, so a
   pathological headline run cannot starve it.  `bench.py --resume` after
   a mid-run kill skips checkpointed stages and finishes the remainder.
-- `bench.py --selftest`: a <60s CPU-only run that injects a TPU-init
+- `bench.py --selftest`: a ~1-minute CPU-only run that injects a TPU-init
   hang (runtime.faults) and asserts every stage — including a miniature
-  rebalance — completes with correct provenance.
+  rebalance and one balancer round of each mgr mode — completes with
+  correct provenance.
 - The PG axis is chunked (BENCH_CHUNK, default 65536): peak device memory
   is O(chunk), not O(BENCH_PGS) — the r02 failure mode (XLA OOM
   materializing [N, T, lanes] intermediates at N=1M) cannot recur.
 - The JAX persistent compilation cache is enabled; repeat runs skip the
   ~20-40s per-config compiles.
 
+A `balancer` stage runs one optimization round of each mgr balancer
+mode (upmap / crush-compat, ceph_tpu.mgr) on a synthetic cluster so the
+BENCH JSON records balancer eval throughput and score deltas.
+
 Env knobs: BENCH_PGS, BENCH_OSDS, BENCH_BASELINE_PGS, BENCH_EC_MB,
 BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU,
 BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
-second mapping config, selftest), plus the CEPH_TPU_FAULTS /
-CEPH_TPU_LADDER / CEPH_TPU_INIT_* runtime knobs.
+second mapping config, selftest), BENCH_BAL_PGS/_OSDS/_COMPAT_ITERS
+(balancer stage), plus the CEPH_TPU_FAULTS / CEPH_TPU_LADDER /
+CEPH_TPU_INIT_* runtime knobs.
 """
 
 from __future__ import annotations
@@ -298,6 +304,61 @@ def bench_rebalance(n_pgs: int, n_osds: int, rounds: int,
     return res
 
 
+def bench_balancer(n_pgs: int, n_osds: int, compat_iters: int) -> dict:
+    """One optimization round of EACH mgr balancer mode on a synthetic
+    cluster (the reference's `ceph balancer optimize` pair: do_upmap /
+    do_crush_compat, pybind/mgr/balancer/module.py:964/1031), scored by
+    calc_eval through the batched pipeline.  Records per-mode wall
+    time, score delta, and eval throughput (PGs scored per second)."""
+    from ceph_tpu.mgr import Balancer, MappingState, synthetic_pg_stats
+
+    m = build_map(n_pgs, n_osds)
+    rng = np.random.default_rng(9)
+    for o in rng.choice(n_osds, max(1, n_osds // 25), replace=False):
+        m.osd_weight[int(o)] = int(0x10000 * 0.8)
+    res: dict = {"pgs": n_pgs, "osds": n_osds}
+    stats = synthetic_pg_stats(m)
+    for mode, opts in (
+        ("upmap", {"upmap_max_optimizations": 16}),
+        ("crush-compat", {"crush_compat_max_iterations": compat_iters}),
+    ):
+        bal = Balancer(options=opts, rng=np.random.default_rng(17))
+        ms = MappingState(m, stats, mapper="jax")
+        before = obs.perf_dump()["mgr"]["eval_pgs_mapped"]
+        t0 = time.perf_counter()
+        with obs.span("bench.balancer", mode=mode, pgs=n_pgs):
+            pe0 = bal.eval(ms)
+            plan = bal.plan_create("bench", ms, mode=mode)
+            rc, detail = bal.optimize(plan)
+            if rc != 0:
+                pe1 = pe0
+            elif plan.final_eval is not None:
+                pe1 = plan.final_eval  # compat: already scored; a
+                # re-eval would recompile the pipeline for nothing
+            else:
+                pe1 = bal.eval(plan.final_state())
+        dt = time.perf_counter() - t0
+        scored = obs.perf_dump()["mgr"]["eval_pgs_mapped"] - before
+        entry = {
+            "rc": rc,
+            "wall_s": round(dt, 2),
+            "score_before": round(pe0.score, 6),
+            "score_after": round(pe1.score, 6),
+            "eval_pgs_per_sec": round(scored / dt, 1) if dt else 0.0,
+        }
+        if rc != 0:
+            entry["detail"] = detail
+        if mode == "upmap":
+            entry["changes"] = (
+                len(plan.inc.new_pg_upmap_items)
+                + len(plan.inc.old_pg_upmap_items)
+            )
+        else:
+            entry["weight_set_osds"] = len(plan.compat_ws)
+        res[mode.replace("-", "_")] = entry
+    return res
+
+
 def bench_c_reference(m, n: int) -> float | None:
     """Single-core C crush_do_rule loop; mappings/sec, None if unavailable."""
     try:
@@ -520,10 +581,25 @@ def worker() -> None:
             r["vs_c"] = round(r["mappings_per_sec"] / ch, 3)
         return r
 
+    def balancer_stage(h):
+        return bench_balancer(
+            int(os.environ.get("BENCH_BAL_PGS", 32768)),
+            int(os.environ.get("BENCH_BAL_OSDS", 256)),
+            # 1 by default: every compat iteration re-compiles the
+            # pipeline (weight tables are trace constants), and one
+            # round is what the stage measures
+            int(os.environ.get("BENCH_BAL_COMPAT_ITERS", 1)),
+        )
+
     sched.add("crushtool_1k_32", cfg1, priority=80, est_s=30,
               min_budget_s=25)
     sched.add("testmappgs_100k_1k", cfg2, priority=70, est_s=60,
               min_budget_s=40)
+    # soft timeout: the balancer stage runs AHEAD of the north-star
+    # rebalance, so the watchdog must bound it — a wedged eval pass may
+    # not re-starve the rebalance number (the r01-r05 failure mode)
+    sched.add("balancer", balancer_stage, priority=65, est_s=90,
+              min_budget_s=45, soft_timeout_s=150)
     sched.add("rebalance", rebalance, priority=60, est_s=150,
               min_budget_s=100)
     sched.add("headline", headline, priority=40, est_s=120,
@@ -579,6 +655,8 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         out["resumed_stages"] = stages["resumed_stages"]
     if "stages_done" in stages:
         out["stages_done"] = list(stages["stages_done"])
+    if "balancer" in stages:
+        out["balancer"] = _strip_perf(stages["balancer"])
     if "rebalance" in stages:
         rb = _strip_perf(stages["rebalance"])
         key = "rebalance"
@@ -701,6 +779,8 @@ SELFTEST_ENV = {
     "BENCH_CFG2_PGS": "4096", "BENCH_CFG2_OSDS": "256",
     "BENCH_BASELINE_PGS": "20000", "BENCH_EC_MB": "2",
     "BENCH_NS_PGS": "2048", "BENCH_NS_OSDS": "64", "BENCH_NS_ROUNDS": "2",
+    "BENCH_BAL_PGS": "1024", "BENCH_BAL_OSDS": "64",
+    "BENCH_BAL_COMPAT_ITERS": "1",
     "BENCH_REPS": "1",
     # generous deadline: the <60s bound comes from the workload being
     # tiny, not from budget-skipping stages (skips would fail the assert)
@@ -715,7 +795,7 @@ SELFTEST_ENV = {
 
 SELFTEST_STAGES = (
     "init", "ec_jax", "ec_clay", "crushtool_1k_32", "testmappgs_100k_1k",
-    "rebalance", "headline",
+    "balancer", "rebalance", "headline",
 )
 
 

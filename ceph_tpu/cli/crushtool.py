@@ -9,7 +9,8 @@ Covers the reference tool's compile/decompile/build/test surface
     crushtool -i map --test [--min-x --max-x --num-rep --rule --pool-id
                              --weight osd w --show-statistics
                              --show-utilization[-all] --show-mappings
-                             --show-bad-mappings --simulate --backend jax|ref]
+                             --show-bad-mappings --show-choose-tries
+                             --simulate --backend jax|ref]
     crushtool -i map --tree
     crushtool -i map --reweight-item name w -o out
 
@@ -215,6 +216,8 @@ def main(argv: list[str] | None = None) -> int:
             cfg.show_mappings = True
         elif a == "--show-bad-mappings":
             cfg.show_bad_mappings = True
+        elif a == "--show-choose-tries":
+            cfg.show_choose_tries = True
         elif a == "--show-utilization":
             cfg.show_utilization = True
         elif a == "--show-utilization-all":

@@ -181,6 +181,12 @@ def compile_text(text: str) -> CrushMap:
             ca_id_tok = p.next()
             try:
                 ca_id: int | str = int(ca_id_tok)
+                # the reference stores choose_args keys as s64 but some
+                # dumps print them as u64 (the compat set shows up as
+                # 18446744073709551615): normalize so -1 stays -1 and
+                # the binary codec's i64 encode can round-trip the map
+                if ca_id >= 1 << 63:
+                    ca_id -= 1 << 64
             except ValueError:
                 ca_id = ca_id_tok
             ca = ChooseArgs()

@@ -49,6 +49,11 @@ class TesterConfig:
     show_utilization: bool = False
     show_utilization_all: bool = False
     show_statistics: bool = False
+    # per-placement retry histogram (reference src/crush/mapper.c:640-643
+    # choose_tries bookkeeping + CrushTester's --show-choose-tries dump).
+    # Collected by the host reference mapper: the tester transparently
+    # routes the mapping loop through backend "ref" when this is set.
+    show_choose_tries: bool = False
 
 
 class CrushTester:
@@ -103,7 +108,10 @@ class CrushTester:
         return self._arrays_cache
 
     def _map_one_ref(self, ruleno: int, x: int, nr: int) -> list[int]:
-        return mapper_ref.do_rule(self.m, ruleno, x, nr, self.weight)
+        return mapper_ref.do_rule(
+            self.m, ruleno, x, nr, self.weight,
+            collect_choose_tries=self.cfg.show_choose_tries,
+        )
 
     def _random_placement(
         self, rng: np.random.Generator, nr: int
@@ -125,9 +133,33 @@ class CrushTester:
             w[pick] = 0
         return out
 
+    @property
+    def choose_tries(self) -> list[int] | None:
+        """The collected histogram: choose_tries[f] = placements that
+        needed f retries (index 0 = first-draw success)."""
+        return self.m.choose_tries_histogram
+
+    def dump_choose_tries(self, out=None) -> None:
+        """Print the histogram, trailing zeros trimmed (the shape of the
+        reference tester's --show-choose-tries output)."""
+        out = out if out is not None else self.out
+        hist = self.choose_tries or []
+        last = max((i for i, v in enumerate(hist) if v), default=-1)
+        print("choose_tries histogram", file=out)
+        for i in range(last + 1):
+            print(f" {i}: {hist[i]}", file=out)
+
     # -- the test loop -----------------------------------------------------
     def test(self) -> int:
         cfg, m = self.cfg, self.m
+        backend = cfg.backend
+        if cfg.show_choose_tries:
+            # only the host reference mapper instruments its retry loops
+            # (local override: the caller's config is not mutated)
+            backend = "ref"
+            m.choose_tries_histogram = [0] * (
+                m.tunables.choose_total_tries + 1
+            )
         rules = (
             [cfg.rule]
             if cfg.rule >= 0
@@ -163,7 +195,7 @@ class CrushTester:
                         self._random_placement(rng, nr) for _ in range(n_x)
                     ]
                     prefix = "RNG"
-                elif cfg.backend == "native":
+                elif backend == "native":
                     from ceph_tpu.native.mapper import NativeMapper
 
                     if getattr(self, "_nm", None) is None:
@@ -173,7 +205,7 @@ class CrushTester:
                     )
                     rows = self._rows_from_padded(padded, rule)
                     prefix = "CRUSH"
-                elif cfg.backend == "ref":
+                elif backend == "ref":
                     rows = [
                         self._map_one_ref(r, int(rx), nr)
                         for rx in self._real_xs(xs)
@@ -233,4 +265,6 @@ class CrushTester:
                                     f"\t expected : {expected[i]:.0f}",
                                     file=w,
                                 )
+        if cfg.show_choose_tries:
+            self.dump_choose_tries()
         return 0

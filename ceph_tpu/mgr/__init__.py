@@ -1,0 +1,23 @@
+"""mgr — manager-module layer over the placement stack.
+
+The reference runs balancing as a mgr module (`pybind/mgr/balancer/
+module.py`): distribution scoring (`Eval`/`calc_eval`), `Plan` objects,
+and two optimization modes — `upmap` (pg_upmap_items via the greedy
+optimizer) and `crush-compat` (per-bucket choose_args weight-sets).
+This package ports those brains over this framework's OSDMap/CRUSH
+model, with the O(PGs) scoring work running through the batched JAX
+pipeline.
+"""
+
+from ceph_tpu.mgr.eval import Eval, MappingState, calc_eval, synthetic_pg_stats
+from ceph_tpu.mgr.module import Balancer, Plan, compat_ws_to_choose_args
+
+__all__ = [
+    "Balancer",
+    "Eval",
+    "MappingState",
+    "Plan",
+    "calc_eval",
+    "compat_ws_to_choose_args",
+    "synthetic_pg_stats",
+]

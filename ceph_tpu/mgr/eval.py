@@ -1,0 +1,368 @@
+"""Distribution scoring — the balancer's Eval / calc_eval.
+
+Semantics port of the reference mgr balancer's scoring pass
+(reference pybind/mgr/balancer/module.py: `Eval` :60-130, `calc_stats`
+:95-150, `calc_eval` :670-790): per pool and per CRUSH root, compare the
+*actual* per-OSD distribution of PGs / objects / bytes against the
+weight-proportional *target*, and reduce each (root, metric) pair to a
+score in [0, 1) — 0 is a perfect distribution; the overall score is the
+mean over roots and metrics.
+
+The scoring formula is the reference's: for each overfull OSD the CDF of
+the standard normal at the relative overfullness, weighted by the OSD's
+target share (module.py:113-124 — erf-based so urgency saturates
+steeply), plus the stddev of the weight-adjusted counts.
+
+The expensive part — mapping every PG of every pool to build the actual
+distributions — runs through the batched JAX pipeline (one XLA call per
+pool, `osd.pipeline_jax.PoolMapper`); the reference iterates pg_dump.
+Object/byte stats have no daemon to come from here, so `MappingState`
+carries a per-PG stats table (synthesize one with `synthetic_pg_stats`);
+stats belong to PGs, not mappings, so the same table must be shared by
+the before/after states a plan is scored against.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.balancer.crush_analysis import (
+    find_takes_by_rule,
+    get_rule_weight_osd_map,
+)
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgId
+
+_L = obs.logger_for("mgr")
+_L.add_u64("evals", "calc_eval passes")
+_L.add_u64("eval_pgs_mapped", "PGs mapped while building eval distributions")
+_L.add_time_avg("eval_seconds", "wall time per calc_eval pass")
+_L.add_avg("score", "eval score after each calc_eval (0 = perfect)")
+
+METRICS = ("pgs", "objects", "bytes")
+
+
+def synthetic_pg_stats(
+    m: OSDMap, objects_per_pg: int = 64, bytes_per_object: int = 4 << 20,
+    seed: int = 0,
+) -> dict[int, dict[str, np.ndarray]]:
+    """Deterministic per-PG object/byte counts (the pg_dump stand-in).
+    Mild spread (x0.5..x1.5 around the mean) so the objects/bytes scores
+    are not degenerate copies of the pgs score."""
+    out: dict[int, dict[str, np.ndarray]] = {}
+    for pid, pool in sorted(m.pools.items()):
+        rng = np.random.default_rng(seed * 1_000_003 + pid)
+        objs = rng.integers(
+            objects_per_pg // 2, objects_per_pg * 3 // 2 + 1,
+            size=pool.pg_num, dtype=np.int64,
+        )
+        out[pid] = {"objects": objs, "bytes": objs * bytes_per_object}
+    return out
+
+
+class MappingState:
+    """Snapshot the balancer scores: an OSDMap + per-PG stats + lazily
+    computed per-pool `up` rows (reference module.py `MappingState`).
+
+    mapper: "jax" maps each pool through the batched pipeline (overlay
+    tensors included, so pg_upmap_items and choose_args are honored —
+    `PoolMapper` resolves `choose_args.get(pool_id, choose_args.get(-1))`
+    exactly like the host oracle); "host" walks
+    `OSDMap.pg_to_up_acting_osds` (small maps, differential tests).
+    """
+
+    def __init__(self, osdmap: OSDMap, pg_stats=None, desc: str = "",
+                 mapper: str = "jax"):
+        self.osdmap = osdmap
+        self.desc = desc
+        self.pg_stats = pg_stats or {}
+        self.mapper = mapper
+        self._up: dict[int, np.ndarray] = {}
+
+    def pool_up(self, pool_id: int) -> np.ndarray:
+        """[pg_num, W] i32 up rows, ITEM_NONE padded."""
+        rows = self._up.get(pool_id)
+        if rows is not None:
+            return rows
+        m = self.osdmap
+        pool = m.pools[pool_id]
+        with obs.span(
+            "mgr.map_pool", pool=pool_id, pgs=pool.pg_num,
+            mapper=self.mapper,
+        ):
+            if self.mapper == "jax":
+                from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+                rows, _, _, _ = PoolMapper(m, pool_id).map_all()
+            else:
+                rows = np.full((pool.pg_num, pool.size), ITEM_NONE, np.int32)
+                for ps in range(pool.pg_num):
+                    up, _, _, _ = m.pg_to_up_acting_osds(PgId(pool_id, ps))
+                    rows[ps, : min(len(up), pool.size)] = up[: pool.size]
+        _L.inc("eval_pgs_mapped", pool.pg_num)
+        self._up[pool_id] = rows
+        return rows
+
+    def misplaced_from(self, other: "MappingState") -> float:
+        """Fraction of PG replica slots mapped differently than in
+        `other` (the reference's calc_misplaced_from: misplaced objects /
+        total; replica slots are the stand-in absent a pg_dump).
+        Vectorized per-row membership (valid rows carry no duplicate
+        OSDs, so elementwise not-a-member == set difference), chunked so
+        the [chunk, W, W] comparison stays O(chunk) memory."""
+        moved = 0
+        total = 0
+        CH = 16384
+        for pid, pool in sorted(self.osdmap.pools.items()):
+            if pid not in other.osdmap.pools:
+                continue
+            a = np.asarray(self.pool_up(pid))
+            b = np.asarray(other.pool_up(pid))
+            n = pool.pg_num
+            total += n * pool.size
+            for i in range(0, n, CH):
+                aa, bb = a[i:i + CH], b[i:i + CH]
+                member = (bb[:, :, None] == aa[:, None, :]).any(axis=2)
+                moved += int(
+                    (~member & (bb != ITEM_NONE) & (bb >= 0)).sum()
+                )
+        return moved / total if total else 0.0
+
+
+@dataclass
+class Eval:
+    """Scored distributions (reference module.py:60-130)."""
+
+    ms: MappingState
+    pool_name: dict[int, str] = field(default_factory=dict)
+    pool_id: dict[str, int] = field(default_factory=dict)
+    pool_roots: dict[str, list[str]] = field(default_factory=dict)
+    root_pools: dict[str, list[str]] = field(default_factory=dict)
+    root_ids: dict[str, int] = field(default_factory=dict)
+    # target_by_root[root] = {osd: normalized weight fraction}
+    target_by_root: dict[str, dict[int, float]] = field(default_factory=dict)
+    count_by_pool: dict = field(default_factory=dict)
+    count_by_root: dict = field(default_factory=dict)
+    actual_by_pool: dict = field(default_factory=dict)
+    actual_by_root: dict = field(default_factory=dict)
+    total_by_pool: dict = field(default_factory=dict)
+    total_by_root: dict = field(default_factory=dict)
+    stats_by_pool: dict = field(default_factory=dict)
+    stats_by_root: dict = field(default_factory=dict)
+    score_by_pool: dict[str, float] = field(default_factory=dict)
+    score_by_root: dict[str, dict[str, float]] = field(default_factory=dict)
+    score: float = 0.0
+
+    def calc_stats(self, count, target, total):
+        """reference module.py:95-150.  `count[t][osd]`, `target[osd]`
+        (fractions summing to 1 per root), `total[t]`."""
+        num = max(len(target), 1)
+        r = {}
+        for t in METRICS:
+            if total[t] == 0:
+                r[t] = {
+                    "avg": 0, "stddev": 0, "sum_weight": 0, "score": 0,
+                }
+                continue
+            avg = float(total[t]) / float(num)
+            dev = 0.0
+            # score in [0, 1): erf of the relative overfullness of each
+            # overweighted device, weighted by its target share
+            # (module.py:113-124 — see the comment block there for why
+            # erf over e.g. 1-e^-x: steeper saturation to 1)
+            score = 0.0
+            sum_weight = 0.0
+            for k, v in count[t].items():
+                if target.get(k):
+                    adjusted = float(v) / target[k] / float(num)
+                else:
+                    adjusted = 0.0
+                if adjusted > avg:
+                    score += target[k] * math.erf(
+                        ((adjusted - avg) / avg) / math.sqrt(2.0)
+                    )
+                    sum_weight += target[k]
+                dev += (avg - adjusted) * (avg - adjusted)
+            stddev = math.sqrt(dev / float(max(num - 1, 1)))
+            score = score / max(sum_weight, 1)
+            r[t] = {
+                "avg": avg,
+                "stddev": stddev,
+                "sum_weight": sum_weight,
+                "score": score,
+            }
+        return r
+
+    def show(self, verbose: bool = False) -> str:
+        ms = self.ms
+        out = [f"[{ms.desc or 'current cluster'}] score {self.score:.6f}"]
+        for root in sorted(self.score_by_root):
+            s = self.score_by_root[root]
+            out.append(
+                f"  root {root!r:12} pools {self.root_pools.get(root)} "
+                + " ".join(f"{t}={s[t]:.6f}" for t in METRICS)
+            )
+        if verbose:
+            for pool in sorted(self.score_by_pool):
+                out.append(
+                    f"  pool {pool!r:12} score "
+                    f"{self.score_by_pool[pool]:.6f}"
+                )
+            for root, tgt in sorted(self.target_by_root.items()):
+                act = self.actual_by_root[root]["pgs"]
+                for osd in sorted(tgt):
+                    out.append(
+                        f"    osd.{osd:<4} target {tgt[osd]:.4f} "
+                        f"actual-pgs {act.get(osd, 0.0):.4f}"
+                    )
+        return "\n".join(out)
+
+
+def calc_eval(ms: MappingState, pools: list[str] | None = None) -> Eval:
+    """Build the scored distributions for `ms` (reference
+    module.py:670-790 `calc_eval`).  `pools` restricts by pool name."""
+    m = ms.osdmap
+    pe = Eval(ms)
+    _L.inc("evals")
+    with obs.span("mgr.calc_eval"), _L.time("eval_seconds"):
+        pool_rule: dict[str, int] = {}
+        for pid, pool in sorted(m.pools.items()):
+            name = m.pool_name.get(pid, f"pool{pid}")
+            if pools and name not in pools:
+                continue
+            ruleno = mapper_ref.find_rule(
+                m.crush, pool.crush_rule, int(pool.type), pool.size
+            )
+            if ruleno < 0:
+                continue
+            pe.pool_name[pid] = name
+            pe.pool_id[name] = pid
+            pool_rule[name] = ruleno
+            pe.pool_roots[name] = []
+
+        # roots + weight-proportional targets (adjusted = crush weight x
+        # in/out reweight, the same weights calc_pg_upmaps balances to)
+        for name, ruleno in pool_rule.items():
+            for take in find_takes_by_rule(m.crush, ruleno):
+                root = m.crush.item_names.get(take, str(take))
+                pe.root_ids[root] = take
+                if root not in pe.pool_roots[name]:
+                    pe.pool_roots[name].append(root)
+                pe.root_pools.setdefault(root, []).append(name)
+                if root in pe.target_by_root:
+                    continue
+                wmap = get_rule_weight_osd_map(m.crush, ruleno)
+                adj = {
+                    osd: w * (m.get_weightf(osd) if osd < m.max_osd else 0.0)
+                    for osd, w in wmap.items()
+                }
+                s = sum(adj.values())
+                pe.target_by_root[root] = {
+                    osd: (w / s if s > 0 else 0.0) for osd, w in adj.items()
+                }
+
+        # actual distributions: one batched mapping pass per pool
+        for root in pe.target_by_root:
+            pe.count_by_root[root] = {
+                t: {osd: 0 for osd in pe.target_by_root[root]}
+                for t in METRICS
+            }
+            pe.total_by_root[root] = {t: 0 for t in METRICS}
+        for name, ruleno in pool_rule.items():
+            pid = pe.pool_id[name]
+            pool = m.pools[pid]
+            n = pool.pg_num
+            rows = np.asarray(ms.pool_up(pid))[:n]
+            stats = ms.pg_stats.get(pid, {})
+            objs = stats.get("objects")
+            byts = stats.get("bytes")
+            o_pg = (np.asarray(objs[:n], np.int64) if objs is not None
+                    else np.ones(n, np.int64))
+            b_pg = (np.asarray(byts[:n], np.int64) if byts is not None
+                    else o_pg << 22)
+            # vectorized per-OSD accumulation (the per-replica Python
+            # loop dominated crush-compat wall time at scale); float64
+            # bincount weights are exact below 2^53, far above any
+            # per-OSD byte total these sims produce
+            valid = (rows != ITEM_NONE) & (rows >= 0)
+            row_idx = np.nonzero(valid)[0]
+            osds = rows[valid].astype(np.int64)
+            minlen = int(osds.max()) + 1 if osds.size else 1
+            c_pgs = np.bincount(osds, minlength=minlen)
+            c_obj = np.bincount(
+                osds, weights=o_pg[row_idx].astype(np.float64),
+                minlength=minlen,
+            )
+            c_byt = np.bincount(
+                osds, weights=b_pg[row_idx].astype(np.float64),
+                minlength=minlen,
+            )
+            present = np.nonzero(c_pgs)[0]
+            cnt = {
+                "pgs": {int(o): int(c_pgs[o]) for o in present},
+                "objects": {int(o): int(round(c_obj[o]))
+                            for o in present},
+                "bytes": {int(o): int(round(c_byt[o])) for o in present},
+            }
+            tot = {t: sum(cnt[t].values()) for t in METRICS}
+            pe.count_by_pool[name] = cnt
+            pe.total_by_pool[name] = tot
+            pe.actual_by_pool[name] = {
+                t: {
+                    osd: v / tot[t] if tot[t] else 0.0
+                    for osd, v in cnt[t].items()
+                }
+                for t in METRICS
+            }
+            for root in pe.pool_roots[name]:
+                rc = pe.count_by_root[root]
+                rt = pe.total_by_root[root]
+                for t in METRICS:
+                    for osd, v in cnt[t].items():
+                        if osd in rc[t]:
+                            rc[t][osd] += v
+                            rt[t] += v
+
+        for root, rc in pe.count_by_root.items():
+            rt = pe.total_by_root[root]
+            pe.actual_by_root[root] = {
+                t: {
+                    osd: v / rt[t] if rt[t] else 0.0
+                    for osd, v in rc[t].items()
+                }
+                for t in METRICS
+            }
+            pe.stats_by_root[root] = pe.calc_stats(
+                rc, pe.target_by_root[root], rt
+            )
+            pe.score_by_root[root] = {
+                t: pe.stats_by_root[root][t]["score"] for t in METRICS
+            }
+
+        for name in pool_rule:
+            target = {}
+            for root in pe.pool_roots[name]:
+                target.update(pe.target_by_root[root])
+            st = pe.calc_stats(
+                pe.count_by_pool[name], target, pe.total_by_pool[name]
+            )
+            pe.stats_by_pool[name] = st
+            pe.score_by_pool[name] = sum(
+                st[t]["score"] for t in METRICS
+            ) / 3.0
+
+        # overall: mean over roots and metrics (module.py:786-790)
+        pe.score = 0.0
+        for root, vs in pe.score_by_root.items():
+            pe.score += vs["pgs"] + vs["objects"] + vs["bytes"]
+        if pe.score_by_root:
+            pe.score /= 3 * len(pe.score_by_root)
+        _L.observe("score", pe.score)
+        obs.counter("mgr.score", pe.score)
+    return pe

@@ -35,7 +35,11 @@ from ceph_tpu.core.intmath import pg_mask_for, stable_mod
 from ceph_tpu.runtime import faults
 from ceph_tpu.core.rjenkins import crush_hash32_2
 from ceph_tpu.crush import mapper_ref
-from ceph_tpu.crush.mapper_jax import RESCUE_PAD, compile_rule
+from ceph_tpu.crush.mapper_jax import (
+    FAST_WINDOW_EXTRA,
+    RESCUE_PAD,
+    compile_rule,
+)
 from ceph_tpu.crush.soa import CrushArrays, build_arrays
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd.osdmap import (
@@ -215,6 +219,7 @@ def compile_pipeline(
     with_primary_affinity: bool = True,
     path: str = "auto",
     with_flag: bool = False,
+    window_extra: int = FAST_WINDOW_EXTRA,
 ):
     """Build the single-PG mapping function for one pool; vmap/jit-ready.
 
@@ -223,15 +228,19 @@ def compile_pipeline(
     weight/primary_affinity u32[DV], DV = max(crush devices, max_osd)) and
     `ov` holds this PG's overlay rows (only statically-enabled ones read).
 
-    path / with_flag: forwarded to the CRUSH kernel (see
+    path / with_flag / window_extra: forwarded to the CRUSH kernel (see
     ceph_tpu.crush.mapper_jax.compile_rule).  With with_flag the tuple
     grows a trailing `unresolved` bool; PoolMapper.map_batch uses it to
     recompute flagged PGs through the loop kernel (bit-exactness rescue).
+    A small window_extra shrinks the fast kernel's candidate window —
+    more lanes flag unresolved and rescue (the fast-window/rescue trade
+    of PROFILE_r05 §5); exactness is unaffected.
     """
     W = spec.out_width
     R = spec.size
     rule_fn = (
-        compile_rule(A, spec.ruleno, R, path=path, with_flag=with_flag)
+        compile_rule(A, spec.ruleno, R, path=path, with_flag=with_flag,
+                     window_extra=window_extra)
         if spec.ruleno >= 0 else None
     )
     D = A.max_devices  # crush device-id bound (weight vec for the kernel)
@@ -390,12 +399,14 @@ class PoolMapper:
     """
 
     def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True,
-                 path: str = "auto", chunk: int | None = DEFAULT_CHUNK):
+                 path: str = "auto", chunk: int | None = DEFAULT_CHUNK,
+                 window_extra: int = FAST_WINDOW_EXTRA):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
         self.m = m
         self.pool_id = pool_id
+        self.window_extra = window_extra
         ca = m.crush.choose_args.get(pool_id, m.crush.choose_args.get(-1))
         self.arrays = build_arrays(m.crush, ca)
         self.ov = build_overlays(m, pool_id) if overlays else Overlays()
@@ -410,11 +421,12 @@ class PoolMapper:
             with_primary_affinity=m.osd_primary_affinity is not None,
         )
         self.fn = compile_pipeline(
-            self.arrays, self.spec, path=path, **self._pipe_kw
+            self.arrays, self.spec, path=path,
+            window_extra=window_extra, **self._pipe_kw
         )
         self._fast = compile_pipeline(
             self.arrays, self.spec, path=path, with_flag=True,
-            **self._pipe_kw,
+            window_extra=window_extra, **self._pipe_kw,
         )
         self.refresh_dev()
         self._jitted = None

@@ -27,6 +27,20 @@ from ceph_tpu.osd.pipeline_jax import PoolMapper
 PG_AXIS = "pg"
 
 
+def _shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """jax.shard_map moved out of jax.experimental at ~0.6; support both
+    spellings (the arg asserting replication also renamed:
+    check_vma <- check_rep)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
     """1-D mesh over the first n devices; the PG axis shards over it.
 
@@ -110,12 +124,11 @@ class ShardedClusterMapper:
             fhist = jax.lax.psum(fhist, axis)
             return up, upp, acting, actp, hist, phist, fhist
 
-        sm = jax.shard_map(
+        sm = _shard_map(
             local,
-            mesh=self.mesh,
-            in_specs=(P(axis), P()),
-            out_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(), P()),
-            check_vma=False,
+            self.mesh,
+            (P(axis), P()),
+            (P(axis), P(axis), P(axis), P(axis), P(), P(), P()),
         )
         return jax.jit(sm)
 
@@ -175,12 +188,11 @@ class ShardedClusterMapper:
             ).astype(jnp.uint32)
             return new_w, stddev, hist
 
-        sm = jax.shard_map(
+        sm = _shard_map(
             local,
-            mesh=self.mesh,
-            in_specs=(P(axis), P(), P()),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
+            self.mesh,
+            (P(axis), P(), P()),
+            (P(), P(), P()),
         )
         return jax.jit(sm)
 

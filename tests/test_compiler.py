@@ -197,3 +197,74 @@ class TestDeviceClasses:
         for x in range(32):
             assert mapper_ref.do_rule(m2, 0, x, 2, weights) == \
                 mapper_ref.do_rule(m, 0, x, 2, weights)
+
+
+class TestChooseArgsRoundtrip:
+    """Multi-position (positions>1) weight_sets through decompile ->
+    compile -> decompile: the per-position rows that drive the straw2
+    row-path fallback must survive the text format byte-exactly
+    (reference src/test/cli/crushtool/choose-args.t)."""
+
+    def _map_with_args(self, positions=3):
+        from ceph_tpu.cli.crushtool import build_map
+        from ceph_tpu.crush.types import ChooseArgs
+
+        rng = np.random.default_rng(11)
+        m = build_map(9, [("host", "straw2", 3), ("root", "straw2", 0)])
+        ca = ChooseArgs()
+        for bid, b in m.buckets.items():
+            ca.weight_sets[bid] = [
+                [int(w) for w in rng.integers(1, 3 * 0x10000, b.size)]
+                for _ in range(positions)
+            ]
+            ca.ids[bid] = [
+                int(i) + 1000 if i >= 0 else int(i) for i in b.items
+            ]
+        m.choose_args[-1] = ca
+        m.choose_args[0] = ChooseArgs(
+            weight_sets={-1: [[0x8000] * m.buckets[-1].size]}
+        )
+        return m, ca
+
+    def test_positions_gt1_roundtrip(self):
+        m, ca = self._map_with_args()
+        text = decompile(m)
+        m2 = compile_text(text)
+        assert decompile(m2) == text
+        assert m2.choose_args[-1].weight_sets == ca.weight_sets
+        assert m2.choose_args[-1].ids == ca.ids
+        assert m2.choose_args[0].weight_sets == {
+            -1: [[0x8000] * m.buckets[-1].size]
+        }
+
+    def test_u64_printed_compat_key_normalizes(self):
+        """Some reference dumps print the compat (-1) key as u64
+        (18446744073709551615); it must parse back to -1 so the binary
+        codec's s64 encode can round-trip the map."""
+        m, ca = self._map_with_args(positions=2)
+        text = decompile(m).replace(
+            "choose_args -1", "choose_args 18446744073709551615"
+        )
+        m2 = compile_text(text)
+        assert m2.choose_args[-1].weight_sets == ca.weight_sets
+
+    def test_binary_codec_roundtrip(self):
+        from ceph_tpu.crush.codec import decode_crushmap, encode_crushmap
+
+        m, ca = self._map_with_args()
+        m3 = decode_crushmap(encode_crushmap(m))
+        assert m3.choose_args[-1].weight_sets == ca.weight_sets
+        assert m3.choose_args[-1].ids == ca.ids
+
+    def test_mapping_respects_compiled_args(self):
+        """The round-tripped positions>1 weight-set changes mappings the
+        same way the original does."""
+        m, ca = self._map_with_args()
+        m2 = compile_text(decompile(m))
+        weights = [0x10000] * 9
+        for x in range(64):
+            a = mapper_ref.do_rule(m, 0, x, 3, weights, ca)
+            b = mapper_ref.do_rule(
+                m2, 0, x, 3, weights, m2.choose_args[-1]
+            )
+            assert a == b, x

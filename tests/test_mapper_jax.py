@@ -266,3 +266,32 @@ def test_rowpath_onehot_reach(rng, row_path):
     w[5] = 0
     w[33] = 0x4000
     compare_jax(m, r, w, 3, n_x=101)
+
+
+def test_choose_args_positions_row_path_fallback(rng):
+    """positions>1 weight-sets through BOTH kernels of both rule types:
+    the row path must fall back per level (a _RowLevel with positions>1
+    is not row_ok) and stay bit-exact, pinning the compat weight-set
+    path the mgr balancer writes (VERDICT r5 item 6)."""
+    from ceph_tpu.crush import mapper_jax
+
+    m, root = build_tree(rng, n_host=4, osd_per_host=4)
+    rrep = m.make_replicated_rule(root, HOST)
+    rind = m.add_rule(Rule([
+        (RuleOp.TAKE, root, 0),
+        (RuleOp.CHOOSELEAF_INDEP, 0, HOST),
+        (RuleOp.EMIT, 0, 0)], ruleset=1, type=3))
+    ca = ChooseArgs()
+    for bid, b in m.buckets.items():
+        ca.weight_sets[bid] = [
+            [int(w) for w in rng.integers(1, 4 * 0x10000, b.size)]
+            for _ in range(3)
+        ]
+        ca.ids[bid] = [int(i) + 7 if i >= 0 else int(i) for i in b.items]
+    old = mapper_jax.FORCE_ROW_PATH
+    try:
+        mapper_jax.FORCE_ROW_PATH = True
+        compare_jax(m, rrep, [0x10000] * 16, 3, n_x=65, choose_args=ca)
+        compare_jax(m, rind, [0x10000] * 16, 3, n_x=65, choose_args=ca)
+    finally:
+        mapper_jax.FORCE_ROW_PATH = old

@@ -1,0 +1,370 @@
+"""mgr balancer tests — eval scoring, both optimization modes, plan
+execution through the Incremental machinery, and the compat weight-set
+consumed bit-exactly by every mapper backend (reference fixtures:
+pybind/mgr/balancer/module.py + src/test/osd/TestOSDMap.cc upmap cases).
+"""
+
+import errno
+import io
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+from ceph_tpu.mgr import (
+    Balancer,
+    MappingState,
+    calc_eval,
+    compat_ws_to_choose_args,
+    synthetic_pg_stats,
+)
+from ceph_tpu.mgr.eval import Eval
+from ceph_tpu.mgr.module import get_compat_weight_set_weights
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.incremental import decode_incremental, encode_incremental
+from ceph_tpu.osd.osdmap import build_hierarchical
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+
+
+def skewed_map(n_host=4, per=4, pg_num=128, skew=2.0):
+    """Alternate-host weight skew: deviation for the optimizers to eat."""
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=pg_num, pgp_num=pg_num)
+
+    def wf(osd):
+        return int(0x10000 * (skew if (osd // per) % 2 else 1.0))
+
+    return build_hierarchical(n_host, per, pool=pool, weight_fn=wf)
+
+
+def host_state(m, desc="current"):
+    return MappingState(m, synthetic_pg_stats(m), desc=desc, mapper="host")
+
+
+class TestCalcStats:
+    def _stats(self, count, target, total):
+        pe = Eval(ms=None)
+        full = {t: dict(count) for t in ("pgs", "objects", "bytes")}
+        tot = {t: total for t in ("pgs", "objects", "bytes")}
+        return pe.calc_stats(full, target, tot)["pgs"]
+
+    def test_perfect_distribution_scores_zero(self):
+        target = {0: 0.25, 1: 0.25, 2: 0.25, 3: 0.25}
+        st = self._stats({o: 100 for o in target}, target, 400)
+        assert st["score"] == 0.0
+        assert st["stddev"] == pytest.approx(0.0)
+
+    def test_weighted_perfect_scores_zero(self):
+        target = {0: 0.5, 1: 0.25, 2: 0.25}
+        st = self._stats({0: 200, 1: 100, 2: 100}, target, 400)
+        assert st["score"] == pytest.approx(0.0)
+
+    def test_overfull_scores_positive_and_bounded(self):
+        target = {0: 0.25, 1: 0.25, 2: 0.25, 3: 0.25}
+        st = self._stats({0: 250, 1: 50, 2: 50, 3: 50}, target, 400)
+        assert 0.0 < st["score"] < 1.0
+        # more imbalance -> strictly worse score
+        st2 = self._stats({0: 370, 1: 10, 2: 10, 3: 10}, target, 400)
+        assert st2["score"] > st["score"]
+
+    def test_empty_total_is_zero(self):
+        st = self._stats({}, {0: 1.0}, 0)
+        assert st["score"] == 0 and st["stddev"] == 0
+
+
+class TestCalcEval:
+    def test_scores_skew(self):
+        pe = calc_eval(host_state(skewed_map()))
+        assert 0.0 < pe.score < 1.0
+        assert set(pe.pool_name.values()) == {"rbd"}
+        assert list(pe.score_by_root) == ["default"]
+        tgt = pe.target_by_root["default"]
+        assert sum(tgt.values()) == pytest.approx(1.0)
+        # counts cover every replica of every PG
+        assert pe.total_by_root["default"]["pgs"] == 128 * 3
+        assert "score" in pe.show()
+
+    def test_forced_imbalance_scores_worse(self):
+        """Piling PGs onto one OSD via upmap must strictly worsen the
+        score (the monotonicity optimize() relies on)."""
+        m = build_hierarchical(4, 4, pool=PgPool(
+            type=PoolType.REPLICATED, size=3, crush_rule=0,
+            pg_num=128, pgp_num=128,
+        ))
+        pe0 = calc_eval(host_state(m))
+        moved = 0
+        for ps in range(128):
+            if moved >= 24:
+                break
+            up, _, _, _ = m.pg_to_up_acting_osds(PgId(0, ps))
+            if 0 in up:
+                continue
+            m.pg_upmap_items[PgId(0, ps)] = [(up[-1], 0)]
+            moved += 1
+        pe1 = calc_eval(host_state(m))
+        assert pe1.score > pe0.score
+
+
+class TestUpmapMode:
+    def test_optimize_improves_and_applies(self):
+        m = skewed_map(pg_num=256)
+        ms = host_state(m)
+        bal = Balancer(rng=np.random.default_rng(42))
+        pe0 = bal.eval(ms)
+        plan = bal.plan_create("p", ms, mode="upmap")
+        rc, detail = bal.optimize(plan)
+        assert rc == 0, detail
+        assert plan.inc.new_pg_upmap_items
+        pe1 = bal.eval(plan.final_state())
+        assert pe1.score < pe0.score
+
+        # the plan IS an Incremental: wire round-trip, then execute
+        blob = encode_incremental(plan.finalize_inc())
+        inc2 = decode_incremental(blob)
+        assert inc2.new_pg_upmap_items == {
+            pg: list(v) for pg, v in plan.inc.new_pg_upmap_items.items()
+        }
+        rc, detail = bal.execute(plan, m)
+        assert rc == 0, detail
+        assert m.epoch == 2
+        assert m.pg_upmap_items == plan.osdmap.pg_upmap_items
+
+    def test_already_balanced_returns_ealready(self):
+        m = build_hierarchical(4, 4, pool=PgPool(
+            type=PoolType.REPLICATED, size=3, crush_rule=0,
+            pg_num=64, pgp_num=64,
+        ))
+        bal = Balancer(
+            options={"upmap_max_deviation": 100},
+            rng=np.random.default_rng(0),
+        )
+        plan = bal.plan_create("p", host_state(m), mode="upmap")
+        rc, detail = bal.optimize(plan)
+        assert rc == -errno.EALREADY
+        assert "optimiz" in detail
+
+    def test_respects_max_optimizations(self):
+        m = skewed_map(pg_num=256)
+        bal = Balancer(
+            options={"upmap_max_optimizations": 3},
+            rng=np.random.default_rng(1),
+        )
+        plan = bal.plan_create("p", host_state(m), mode="upmap")
+        rc, _ = bal.optimize(plan)
+        assert rc == 0
+        changed = len(plan.inc.new_pg_upmap_items) + len(
+            plan.inc.old_pg_upmap_items
+        )
+        assert 0 < changed <= 3
+
+
+class TestCrushCompatMode:
+    def _optimized(self, iterations=8, pg_num=128):
+        m = skewed_map(pg_num=pg_num)
+        ms = host_state(m)
+        bal = Balancer(
+            options={"crush_compat_max_iterations": iterations},
+            rng=np.random.default_rng(7),
+        )
+        pe0 = bal.eval(ms)
+        plan = bal.plan_create("c", ms, mode="crush-compat")
+        rc, detail = bal.optimize(plan)
+        assert rc == 0, detail
+        return m, bal, plan, pe0
+
+    def test_score_strictly_improves(self):
+        m, bal, plan, pe0 = self._optimized()
+        pe1 = bal.eval(plan.final_state())
+        assert pe1.score < pe0.score
+        assert plan.compat_ws
+
+    def test_writes_real_choose_args(self):
+        m, bal, plan, _ = self._optimized(iterations=4)
+        ca = plan.osdmap.crush.choose_args[-1]
+        # one row (position) per bucket, row length == bucket size,
+        # internal-node entries = subtree weight-set sums
+        for bid, b in plan.osdmap.crush.buckets.items():
+            rows = ca.weight_sets[bid]
+            assert len(rows) == 1 and len(rows[0]) == b.size
+        ws = get_compat_weight_set_weights(plan.osdmap.crush)
+        for osd, w in plan.compat_ws.items():
+            assert ws[osd] == pytest.approx(w, abs=2 / 0x10000)
+
+    def test_execute_carries_weight_set_through_incremental(self):
+        m, bal, plan, _ = self._optimized(iterations=4)
+        rc, detail = bal.execute(plan, m)
+        assert rc == 0, detail
+        assert m.epoch == 2
+        assert -1 in m.crush.choose_args
+        # the crush blob round-trip preserves the mapping bit-for-bit
+        for ps in range(0, 128, 7):
+            a = m.pg_to_up_acting_osds(PgId(0, ps))
+            b = plan.osdmap.pg_to_up_acting_osds(PgId(0, ps))
+            assert a == b, ps
+
+    def test_failure_restores_working_map(self):
+        """A rejected optimization (every candidate exceeds the
+        misplaced ratio -> EDOM) must leave the plan's working map in
+        its ORIGINAL state, not with the last rejected weight-set."""
+        m = skewed_map()
+        orig_weights = list(m.osd_weight)
+        bal = Balancer(
+            options={"crush_compat_max_iterations": 3,
+                     "target_max_misplaced_ratio": 0.0},
+            rng=np.random.default_rng(7),
+        )
+        plan = bal.plan_create("c", host_state(m), mode="crush-compat")
+        rc, _ = bal.optimize(plan)
+        assert rc == -errno.EDOM
+        assert plan.compat_ws == {} and plan.osd_weights == {}
+        assert -1 not in plan.osdmap.crush.choose_args
+        assert plan.osdmap.osd_weight == orig_weights
+
+    def test_stale_plan_rejected(self):
+        m, bal, plan, _ = self._optimized(iterations=2)
+        m.epoch += 1
+        rc, detail = bal.execute(plan, m)
+        assert rc == -errno.ESTALE and "epoch" in detail
+
+
+def test_compat_weight_set_consumed_by_pipeline():
+    """A written compat weight-set flows through the batched JAX
+    pipeline bit-exactly (choose_args fallback key -1, the path the
+    mgr's plans rely on)."""
+    m = skewed_map(pg_num=64)
+    ws = get_compat_weight_set_weights(m.crush)
+    rng = np.random.default_rng(5)
+    ws = {o: w * float(rng.uniform(0.6, 1.4)) for o, w in ws.items()}
+    m.crush.choose_args[-1] = compat_ws_to_choose_args(m.crush, ws)
+
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+    up, upp, _, _ = PoolMapper(m, 0).map_all()
+    for ps in range(64):
+        w_up, w_upp, _, _ = m.pg_to_up_acting_osds(PgId(0, ps))
+        got = [o for o in up[ps] if o != ITEM_NONE]
+        assert got == w_up, ps
+        assert upp[ps] == w_upp, ps
+
+
+class TestCli:
+    def test_optimize_show_execute(self, tmp_path, capsys):
+        from ceph_tpu.cli.balancer import main
+
+        plan_fn = tmp_path / "plan.inc"
+        out_fn = tmp_path / "out.bin"
+        rc = main([
+            "--synthetic", "4,4,128", "--mapper", "host",
+            "optimize", "t1", "--mode", "upmap",
+            "--plan-out", str(plan_fn),
+            "--execute", "-o", str(out_fn),
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "score" in out and "->" in out
+        before, after = (
+            float(tok) for tok in
+            [ln for ln in out.splitlines() if ln.startswith("score")][0]
+            .split()[1:4:2]
+        )
+        assert after < before
+        assert plan_fn.exists() and out_fn.exists()
+
+        rc = main(["show", str(plan_fn)])
+        assert rc == 0
+        shown = capsys.readouterr().out
+        assert "pg-upmap-items" in shown
+
+        # applying the plan file to the original map reproduces the
+        # executed map's epoch
+        rc = main([
+            "--synthetic", "4,4,128", "execute", str(plan_fn),
+        ])
+        assert rc == 0
+        assert "epoch 2" in capsys.readouterr().out
+
+    def test_eval_and_status(self, capsys):
+        from ceph_tpu.cli.balancer import main
+
+        assert main(["--synthetic", "4,4,64", "--mapper", "host",
+                     "eval", "-v"]) == 0
+        out = capsys.readouterr().out
+        assert "score" in out and "osd." in out
+        assert main(["status"]) == 0
+        assert '"mode"' in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_mgr_loop_state_backends_equivalent_100k():
+    """Satellite: the mgr do_upmap loop at 100k PGs makes IDENTICAL
+    decisions on the reference-faithful SetState and the
+    device-resident DeviceState (balancer/state.py equivalence, now
+    under the module-level pool iteration)."""
+    def run(backend):
+        pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                      pg_num=100_000, pgp_num=100_000)
+        m = build_hierarchical(8, 8, n_rack=2, pool=pool)
+        for o in range(0, 16):
+            m.osd_weight[o] = int(0x10000 * 0.8)
+        bal = Balancer(
+            options={"upmap_state_backend": backend,
+                     "upmap_max_optimizations": 12},
+            rng=np.random.default_rng(99),
+        )
+        ms = MappingState(m, synthetic_pg_stats(m), mapper="jax")
+        plan = bal.plan_create("p", ms, mode="upmap")
+        rc, detail = bal.optimize(plan)
+        assert rc in (0, -errno.EALREADY), detail
+        return plan
+
+    p_sets = run("sets")
+    p_dev = run("device")
+    assert p_sets.inc.new_pg_upmap_items == p_dev.inc.new_pg_upmap_items
+    assert p_sets.inc.old_pg_upmap_items == p_dev.inc.old_pg_upmap_items
+    assert p_sets.osdmap.pg_upmap_items == p_dev.osdmap.pg_upmap_items
+
+
+@pytest.mark.slow
+def test_compat_weight_set_bitexact_jax_vs_native_100k():
+    """Acceptance: the weight-set a crush-compat plan writes produces
+    bit-identical mappings from mapper_jax and native/mapper.py at
+    >=100k placement seeds."""
+    from ceph_tpu.crush import mapper_ref
+    from ceph_tpu.crush.mapper_jax import compile_batched
+    from ceph_tpu.crush.soa import build_arrays
+
+    m = skewed_map(n_host=8, per=8, pg_num=256)
+    bal = Balancer(
+        options={"crush_compat_max_iterations": 5},
+        rng=np.random.default_rng(3),
+    )
+    plan = bal.plan_create("c", host_state(m), mode="crush-compat")
+    rc, detail = bal.optimize(plan)
+    assert rc == 0, detail
+    crush = plan.osdmap.crush
+    ca = crush.choose_args[-1]
+
+    A = build_arrays(crush, ca)
+    n = 100_000
+    xs = (np.arange(n, dtype=np.uint32) * 2654435761) % (2**31)
+    weights = [w for w in plan.osdmap.osd_weight]
+    dev_w = np.asarray(weights, np.uint32)
+    jax_rows = np.asarray(compile_batched(A, 0, 3)(xs, dev_w))
+
+    try:
+        from ceph_tpu.native.mapper import NativeMapper, available
+    except Exception:
+        available = lambda: False  # noqa: E731
+    if not available():
+        pytest.skip("native crush engine unavailable (no C++ toolchain)")
+    nat_rows = NativeMapper(crush, choose_args=ca).map_batch(
+        0, xs, 3, weights
+    )
+    assert np.array_equal(jax_rows, nat_rows)
+    # ground a sample against the host reference oracle as well
+    for i in range(0, n, n // 64):
+        want = mapper_ref.do_rule(
+            crush, 0, int(xs[i]), 3, list(weights), ca
+        )
+        want = (want + [ITEM_NONE] * 3)[:3]
+        assert list(jax_rows[i]) == want, i

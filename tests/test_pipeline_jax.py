@@ -225,3 +225,30 @@ def test_choose_args_default_fallback(rng):
         ]
     m.crush.choose_args[-1] = ca
     check_pool(m, 0)
+
+
+def test_choose_args_positions_gt1_pipeline(rng):
+    """A positions>1 weight-set keyed to the pool flows through the full
+    batched pipeline (forcing the exact-loop kernel: the fast path's
+    positions==1 precondition fails) and agrees with the host oracle."""
+    from ceph_tpu.crush.types import ChooseArgs
+
+    m = hier_map(
+        rng, pool=PgPool(pg_num=64, size=3), n_host=4
+    )
+    pid = sorted(m.pools)[0]
+    ca = ChooseArgs()
+    for bid, b in m.crush.buckets.items():
+        ca.weight_sets[bid] = [
+            [int(w) for w in rng.integers(1, 3 * 0x10000, b.size)]
+            for _ in range(2)
+        ]
+    m.crush.choose_args[pid] = ca
+    pm = PoolMapper(m, pid)
+    assert pm.arrays.positions == 2
+    up, upp, acting, actp = pm.map_all()
+    for ps in range(64):
+        w_up, w_upp, w_act, w_actp = m.pg_to_up_acting_osds(PgId(pid, ps))
+        got = [o for o in up[ps] if o != ITEM_NONE]
+        assert got == w_up, ps
+        assert upp[ps] == w_upp, ps

@@ -32,6 +32,7 @@ HOT_PACKAGES = (
     "ceph_tpu/osd",
     "ceph_tpu/ec",
     "ceph_tpu/balancer",
+    "ceph_tpu/mgr",
 )
 
 
