@@ -31,6 +31,7 @@ from __future__ import annotations
 import numpy as np
 
 from ceph_tpu import obs
+from ceph_tpu.core import reduce
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd.types import PgId
 
@@ -109,7 +110,11 @@ class DeviceState:
         import jax
         import jax.numpy as jnp
 
-        from ceph_tpu.osd.pipeline_jax import DEFAULT_CHUNK, PoolMapper
+        from ceph_tpu.osd.pipeline_jax import (
+            DEFAULT_CHUNK,
+            PoolMapper,
+            overlay_fixup_rows,
+        )
 
         self.jnp = jnp
         self.jax = jax
@@ -129,7 +134,7 @@ class DeviceState:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             self._sharding = NamedSharding(mesh, P(mesh.axis_names[0], None))
-        counts = jnp.zeros(self.max_osd + 1, jnp.int64)
+        counts = jnp.zeros(self.max_osd, jnp.int64)
         for pid in sorted(m.pools):
             if only_pools and pid not in only_pools:
                 continue
@@ -152,18 +157,11 @@ class DeviceState:
             n = pm.spec.pg_num
             with obs.span("balancer.map_pool", pool=pid, pgs=n):
                 rows = pm.map_all_device(chunk)
-            fixups = [
-                pg.seed for pg in
-                list(m.pg_upmap) + list(m.pg_upmap_items)
-                if pg.pool == pid and pg.seed < n
-            ]
-            if fixups:
-                W = rows.shape[1]
-                fix_rows = np.full((len(fixups), W), ITEM_NONE, np.int32)
-                for i, seed in enumerate(fixups):
-                    up, _, _, _ = m.pg_to_up_acting_osds(PgId(pid, seed))
-                    fix_rows[i, : min(len(up), W)] = up[:W]
-                rows = rows.at[jnp.asarray(fixups)].set(
+            seeds, fix_rows = overlay_fixup_rows(
+                m, pid, int(rows.shape[1])
+            )
+            if len(seeds):
+                rows = rows.at[jnp.asarray(seeds)].set(
                     jnp.asarray(fix_rows)
                 )
             if mesh is not None:
@@ -181,11 +179,10 @@ class DeviceState:
             self.rows[pid] = rows
             self.pg_num[pid] = n
             live = jnp.arange(rows.shape[0]) < n
-            valid = (rows != ITEM_NONE) & (rows >= 0) & live[:, None]
-            idx = jnp.where(valid, jnp.clip(rows, 0, self.max_osd),
-                            self.max_osd)
-            counts = counts.at[idx.reshape(-1)].add(1)
-        self.counts = np.array(counts[: self.max_osd])  # tiny fetch; writable
+            counts = counts + reduce.osd_histogram(
+                rows, self.max_osd, live[:, None], dtype=jnp.int64
+            )
+        self.counts = np.array(counts)  # tiny fetch; writable
         self._pgs_cache: dict[int, list] = {}
 
     # -- deviations ------------------------------------------------------

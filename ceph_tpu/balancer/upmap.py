@@ -240,15 +240,29 @@ def _build_pgs_by_osd(
     m: OSDMap, only_pools, use_tpu: bool
 ) -> dict[int, set]:
     """Map every PG of every (selected) pool; the reference's per-PG loop
-    (OSDMap.cc:4652-4665) replaced by the batched pipeline."""
+    (OSDMap.cc:4652-4665) replaced by the batched pipeline.
+
+    The TPU path runs the OVERLAY-FREE kernel and fixes up the few
+    upmap-carrying PGs from the host oracle: the compiled pipeline's
+    shape then never depends on how many pg_upmap entries have
+    accumulated, so every round of every rebalance run dispatches
+    through one _PIPE_CACHE entry instead of recompiling."""
     pgs_by_osd: dict[int, set] = {}
     for pool_id, pool in sorted(m.pools.items()):
         if only_pools and pool_id not in only_pools:
             continue
         if use_tpu:
-            from ceph_tpu.osd.pipeline_jax import PoolMapper
+            import numpy as _np
 
-            up, _, _, _ = PoolMapper(m, pool_id).map_all()
+            from ceph_tpu.osd.pipeline_jax import (
+                PoolMapper,
+                overlay_fixup_rows,
+            )
+
+            pm = PoolMapper(m, pool_id, overlays=False)
+            up = _np.array(pm.map_all_device())  # writable: fixups below
+            seeds, fix = overlay_fixup_rows(m, pool_id, up.shape[1])
+            up[seeds] = fix
             for ps in range(pool.pg_num):
                 pg = PgId(pool_id, ps)
                 for osd in up[ps]:

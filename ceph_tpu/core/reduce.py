@@ -1,0 +1,88 @@
+"""Shared device-resident reductions over mapping rows.
+
+The reference tools all fold per-PG mappings into tiny per-OSD summaries
+on the host as they loop (CrushTester's utilization histogram, reference
+src/crush/CrushTester.cc:637-698; osdmaptool's pgs/primary counts,
+src/tools/osdmaptool.cc:696-754; the balancer's deviation stddev,
+src/osd/OSDMap.cc:4707-4732).  The batched pipeline produces the rows on
+device, so fetching O(PGs) rows to re-reduce them on host wastes exactly
+the transfer the batching saved.  These helpers do the same reductions ON
+DEVICE — callers fetch only the O(OSDs) or O(1) results.  (Reductions
+over data that already lives on host in O(OSDs) form — e.g. the
+balancer's deviation bookkeeping over incrementally-maintained counts —
+deliberately stay host-side; only row-shaped inputs belong here.)
+
+All functions are plain traceable jax code (usable inside other jits /
+shard_map bodies — ceph_tpu.parallel.sharded reuses osd_histogram under a
+psum); none of them jit themselves.  `rows` is any integer array of OSD
+ids where ITEM_NONE / negative values mark empty lanes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ceph_tpu.crush.types import ITEM_NONE
+
+
+def valid_lanes(rows):
+    """Occupied lanes: not NONE, a real non-negative OSD id."""
+    return (rows != ITEM_NONE) & (rows >= 0)
+
+
+def osd_histogram(ids, n: int, extra_mask=None, dtype=jnp.int32):
+    """Per-OSD counts via scatter-add; invalid lanes (ITEM_NONE pads, -1
+    no-primary markers, out-of-range ids) fall off the end."""
+    valid = valid_lanes(ids) & (ids < n)
+    if extra_mask is not None:
+        valid = valid & extra_mask
+    idx = jnp.where(valid, jnp.clip(ids, 0, n - 1), n)
+    return jnp.zeros(n + 1, dtype).at[idx.reshape(-1)].add(1)[:n]
+
+
+def weighted_osd_histogram(rows, row_weight, n: int, extra_mask=None):
+    """Per-OSD sums of a per-row weight: rows [N, W] of OSD ids,
+    row_weight [N] broadcast across the W replica lanes.  float64
+    accumulation — exact for integer-valued weights below 2^53 (objects /
+    bytes totals), matching a host np.bincount bit for bit."""
+    valid = valid_lanes(rows) & (rows < n)
+    if extra_mask is not None:
+        valid = valid & extra_mask
+    idx = jnp.where(valid, jnp.clip(rows, 0, n - 1), n)
+    w = jnp.broadcast_to(
+        jnp.asarray(row_weight, jnp.float64)[:, None], rows.shape
+    )
+    w = jnp.where(valid, w, 0.0)
+    return jnp.zeros(n + 1, jnp.float64).at[idx.reshape(-1)].add(
+        w.reshape(-1)
+    )[:n]
+
+
+def result_sizes(rows, extra_mask=None):
+    """Per-row count of occupied lanes (the tester's `result size`)."""
+    valid = valid_lanes(rows)
+    if extra_mask is not None:
+        valid = valid & extra_mask
+    return jnp.sum(valid.astype(jnp.int32), axis=-1)
+
+
+def size_histogram(rows, max_size: int, extra_mask=None, dtype=jnp.int64):
+    """Histogram of result_sizes over [0, max_size]."""
+    sz = result_sizes(rows, extra_mask)
+    return jnp.zeros(max_size + 1, dtype).at[
+        jnp.clip(sz, 0, max_size)
+    ].add(1)
+
+
+def misplaced_lanes(before, after, extra_mask=None):
+    """Count of occupied `after` lanes whose OSD is not a member of the
+    same row in `before` — the replica-slot form of the reference's
+    calc_misplaced_from.  Valid rows carry no duplicate OSDs, so
+    elementwise not-a-member == set difference.  [N, W] x [N, W] -> i64
+    scalar (device); chunk the N axis host-side if W is wide enough for
+    the [N, W, W] compare to matter."""
+    member = (after[:, :, None] == before[:, None, :]).any(axis=2)
+    moved = ~member & valid_lanes(after)
+    if extra_mask is not None:
+        moved = moved & extra_mask
+    return jnp.sum(moved.astype(jnp.int64))

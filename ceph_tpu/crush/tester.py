@@ -98,6 +98,35 @@ class CrushTester:
         return np.asarray(fn(xs.astype(np.uint32),
                              np.asarray(self.weight, np.uint32)))
 
+    def _stats_batch_jax(self, ruleno: int, xs: np.ndarray, nr: int, rule):
+        """Per-device utilization + result-size histogram with the rows
+        staying ON DEVICE (ceph_tpu.core.reduce): the tester fetches only
+        the O(devices) summaries it prints — the device-resident form of
+        the reference's host-side accumulation loop (reference
+        src/crush/CrushTester.cc:637-698)."""
+        from ceph_tpu.utils import ensure_jax_backend
+
+        ensure_jax_backend()
+        import jax.numpy as jnp
+
+        from ceph_tpu.core import reduce
+        from ceph_tpu.crush.mapper_jax import compile_batched
+
+        fn = compile_batched(self.m_arrays(), ruleno, nr)
+        rows = fn(xs.astype(np.uint32),
+                  np.asarray(self.weight, np.uint32), device=True)
+        per = np.asarray(
+            reduce.osd_histogram(rows, self.m.max_devices, dtype=jnp.int64)
+        )
+        if rule.type == 1:
+            # firstn compacts ITEM_NONE away: size = occupied lanes
+            sh = np.asarray(reduce.size_histogram(rows, nr))
+            sizes = {i: int(c) for i, c in enumerate(sh) if c}
+        else:
+            # indep keeps positions: every row reports the padded width
+            sizes = {int(rows.shape[1]): int(rows.shape[0])}
+        return per, sizes
+
     _arrays_cache = None
 
     def m_arrays(self):
@@ -211,11 +240,21 @@ class CrushTester:
                         for rx in self._real_xs(xs)
                     ]
                     prefix = "CRUSH"
+                elif not (cfg.show_mappings or cfg.show_bad_mappings):
+                    # nothing per-row to print: reduce on device, fetch
+                    # only the O(devices) summaries
+                    per_d, sizes_d = self._stats_batch_jax(
+                        r, self._real_xs(xs), nr, rule
+                    )
+                    per += per_d
+                    for sz, cn in sizes_d.items():
+                        sizes[sz] = sizes.get(sz, 0) + cn
+                    rows = None
                 else:
                     padded = self._map_batch_jax(r, self._real_xs(xs), nr)
                     rows = self._rows_from_padded(padded, rule)
                     prefix = "CRUSH"
-                for x, out_row in zip(xs, rows):
+                for x, out_row in zip(xs, rows or ()):
                     if cfg.show_mappings:
                         print(
                             f"{prefix} rule {r} x {x} {_vec(out_row)}",

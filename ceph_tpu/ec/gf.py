@@ -124,6 +124,36 @@ def gf_invert_matrix(M):
     return aug[:, n:]
 
 
+# -- device-resident table cache --------------------------------------------
+
+_DEV_TABLES: dict[str, dict] = {}  # jax backend name -> device arrays
+
+
+def gf_device_tables() -> dict:
+    """GF(2^8) log/exp/mul tables as DEVICE arrays, uploaded once per jax
+    backend and shared by every engine/kernel in the process.  Device
+    kernels take these as runtime operands (ec.jax_backend._matmul_logexp)
+    instead of re-embedding the tables as trace constants per code
+    matrix — one device_put total, zero per-call re-upload.  Keys:
+    `exp` u8[512], `log` i32[256] (log[0] = 0 sentinel; callers mask zero
+    operands), `mul` u8[256, 256]."""
+    import jax
+    import jax.numpy as jnp
+
+    b = jax.default_backend()
+    t = _DEV_TABLES.get(b)
+    if t is None:
+        t = {
+            "exp": jnp.asarray(GF_EXP),
+            "log": jnp.asarray(
+                np.where(np.arange(256) == 0, 0, GF_LOG).astype(np.int32)
+            ),
+            "mul": jnp.asarray(GF_MUL_TABLE),
+        }
+        _DEV_TABLES[b] = t
+    return t
+
+
 # -- bit-plane (GF(2)) representation ---------------------------------------
 # Multiplication by a constant c is GF(2)-linear on the 8 bits of the input
 # byte, so any GF(2^8) code matrix expands to a bit-matrix over GF(2); this

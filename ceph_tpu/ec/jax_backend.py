@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ceph_tpu import obs
-from ceph_tpu.ec.gf import GF_EXP, GF_LOG, matrix_to_bitmatrix
+from ceph_tpu.ec.gf import GF_LOG, gf_device_tables, matrix_to_bitmatrix
 
 _BIT_TILE = 1 << 17  # bytes per lane-tile in the bitplane path
 
@@ -75,10 +75,11 @@ def _matmul_bitplane(Bbits, data, n_out):
 
 
 @partial(jax.jit, static_argnums=(0,))
-def _matmul_logexp(M_tuple, data):
-    """M as a static tuple of rows of ints; data: uint8[S, L]."""
-    exp = jnp.asarray(GF_EXP)  # [512]
-    log = jnp.asarray(np.where(np.arange(256) == 0, 0, GF_LOG).astype(np.int32))
+def _matmul_logexp(M_tuple, data, exp, log):
+    """M as a static tuple of rows of ints; data: uint8[S, L].  The
+    log/exp tables are OPERANDS (gf_device_tables: one device_put per
+    backend) — as trace constants they were re-embedded and re-uploaded
+    on every per-matrix retrace of this kernel."""
     logd = log[data]  # [S, L]
     nz = data != 0
     rows = []
@@ -204,7 +205,8 @@ class JaxEngine:
             if mt is None:
                 mt = tuple(tuple(int(c) for c in r) for r in M)
                 self._logexp_cache[key] = mt
-            return finish(_matmul_logexp(mt, d))
+            gft = gf_device_tables()
+            return finish(_matmul_logexp(mt, d, gft["exp"], gft["log"]))
         B = self._bitmat(M)
         R = M.shape[0]
         if self.strategy == "pallas":

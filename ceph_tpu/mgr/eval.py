@@ -70,11 +70,16 @@ class MappingState:
     """Snapshot the balancer scores: an OSDMap + per-PG stats + lazily
     computed per-pool `up` rows (reference module.py `MappingState`).
 
-    mapper: "jax" maps each pool through the batched pipeline (overlay
-    tensors included, so pg_upmap_items and choose_args are honored —
-    `PoolMapper` resolves `choose_args.get(pool_id, choose_args.get(-1))`
-    exactly like the host oracle); "host" walks
-    `OSDMap.pg_to_up_acting_osds` (small maps, differential tests).
+    mapper: "jax" maps each pool through the batched pipeline and keeps
+    the rows DEVICE-RESIDENT — scoring and misplacement reduce on device
+    (ceph_tpu.core.reduce) and only O(OSDs) vectors are fetched.  The
+    pipeline runs overlay-free (so every balancer iteration shares the
+    one compiled kernel regardless of accumulating pg_upmap entries); the
+    few overlay-carrying PGs get exact host-computed rows scattered in,
+    bit-identical to the overlay-gated kernel.  `PoolMapper` resolves
+    `choose_args.get(pool_id, choose_args.get(-1))` exactly like the host
+    oracle.  "host" walks `OSDMap.pg_to_up_acting_osds` (small maps,
+    differential tests).
     """
 
     def __init__(self, osdmap: OSDMap, pg_stats=None, desc: str = "",
@@ -84,30 +89,70 @@ class MappingState:
         self.pg_stats = pg_stats or {}
         self.mapper = mapper
         self._up: dict[int, np.ndarray] = {}
+        self._dev: dict[int, object] = {}
+
+    def pool_up_device(self, pool_id: int):
+        """[pg_num, W] i32 up rows as a DEVICE array (jax), overlay PGs
+        fixed up from the host oracle."""
+        rows = self._dev.get(pool_id)
+        if rows is not None:
+            return rows
+        import jax.numpy as jnp
+
+        from ceph_tpu.osd.pipeline_jax import PoolMapper, overlay_fixup_rows
+
+        m = self.osdmap
+        pool = m.pools[pool_id]
+        n = pool.pg_num
+        with obs.span("mgr.map_pool", pool=pool_id, pgs=n, mapper="jax"):
+            pm = PoolMapper(m, pool_id, overlays=False)
+            rows = pm.map_all_device()
+            seeds, fix = overlay_fixup_rows(m, pool_id, int(rows.shape[1]))
+            if len(seeds):
+                rows = rows.at[jnp.asarray(seeds)].set(jnp.asarray(fix))
+        _L.inc("eval_pgs_mapped", n)
+        self._dev[pool_id] = rows
+        return rows
 
     def pool_up(self, pool_id: int) -> np.ndarray:
-        """[pg_num, W] i32 up rows, ITEM_NONE padded."""
+        """[pg_num, W] i32 up rows, ITEM_NONE padded (host numpy)."""
         rows = self._up.get(pool_id)
         if rows is not None:
             return rows
         m = self.osdmap
         pool = m.pools[pool_id]
-        with obs.span(
-            "mgr.map_pool", pool=pool_id, pgs=pool.pg_num,
-            mapper=self.mapper,
-        ):
-            if self.mapper == "jax":
-                from ceph_tpu.osd.pipeline_jax import PoolMapper
-
-                rows, _, _, _ = PoolMapper(m, pool_id).map_all()
-            else:
+        if self.mapper == "jax":
+            rows = np.asarray(self.pool_up_device(pool_id))
+        else:
+            with obs.span(
+                "mgr.map_pool", pool=pool_id, pgs=pool.pg_num,
+                mapper=self.mapper,
+            ):
                 rows = np.full((pool.pg_num, pool.size), ITEM_NONE, np.int32)
                 for ps in range(pool.pg_num):
                     up, _, _, _ = m.pg_to_up_acting_osds(PgId(pool_id, ps))
                     rows[ps, : min(len(up), pool.size)] = up[: pool.size]
-        _L.inc("eval_pgs_mapped", pool.pg_num)
+            _L.inc("eval_pgs_mapped", pool.pg_num)
         self._up[pool_id] = rows
         return rows
+
+    def pool_counts(self, pool_id: int, o_pg: np.ndarray, b_pg: np.ndarray):
+        """Per-OSD (pgs, objects, bytes) totals for one pool, reduced ON
+        DEVICE from the device rows (mapper="jax"); only the O(OSDs)
+        vectors cross to the host.  float64 scatter-adds of integer
+        weights are exact below 2^53, so the result matches the host
+        np.bincount path bit for bit."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.core import reduce
+
+        n_osd = max(int(self.osdmap.max_osd), 1)
+        rows = self.pool_up_device(pool_id)
+        with obs.span("mgr.pool_counts", pool=pool_id, osds=n_osd):
+            c_pgs = reduce.osd_histogram(rows, n_osd, dtype=jnp.int64)
+            c_obj = reduce.weighted_osd_histogram(rows, o_pg, n_osd)
+            c_byt = reduce.weighted_osd_histogram(rows, b_pg, n_osd)
+            return np.asarray(c_pgs), np.asarray(c_obj), np.asarray(c_byt)
 
     def misplaced_from(self, other: "MappingState") -> float:
         """Fraction of PG replica slots mapped differently than in
@@ -115,17 +160,32 @@ class MappingState:
         total; replica slots are the stand-in absent a pg_dump).
         Vectorized per-row membership (valid rows carry no duplicate
         OSDs, so elementwise not-a-member == set difference), chunked so
-        the [chunk, W, W] comparison stays O(chunk) memory."""
+        the [chunk, W, W] comparison stays O(chunk) memory.  With both
+        states on the jax mapper the comparison runs on device and only
+        the scalar count is fetched."""
         moved = 0
         total = 0
         CH = 16384
+        use_dev = self.mapper == "jax" and other.mapper == "jax"
         for pid, pool in sorted(self.osdmap.pools.items()):
             if pid not in other.osdmap.pools:
                 continue
-            a = np.asarray(self.pool_up(pid))
-            b = np.asarray(other.pool_up(pid))
             n = pool.pg_num
             total += n * pool.size
+            if use_dev:
+                from ceph_tpu.core import reduce
+
+                a = self.pool_up_device(pid)
+                b = other.pool_up_device(pid)
+                acc = 0
+                for i in range(0, n, CH):
+                    acc = acc + reduce.misplaced_lanes(
+                        a[i:i + CH], b[i:i + CH]
+                    )
+                moved += int(acc)
+                continue
+            a = np.asarray(self.pool_up(pid))
+            b = np.asarray(other.pool_up(pid))
             for i in range(0, n, CH):
                 aa, bb = a[i:i + CH], b[i:i + CH]
                 member = (bb[:, :, None] == aa[:, None, :]).any(axis=2)
@@ -278,7 +338,6 @@ def calc_eval(ms: MappingState, pools: list[str] | None = None) -> Eval:
             pid = pe.pool_id[name]
             pool = m.pools[pid]
             n = pool.pg_num
-            rows = np.asarray(ms.pool_up(pid))[:n]
             stats = ms.pg_stats.get(pid, {})
             objs = stats.get("objects")
             byts = stats.get("bytes")
@@ -286,23 +345,29 @@ def calc_eval(ms: MappingState, pools: list[str] | None = None) -> Eval:
                     else np.ones(n, np.int64))
             b_pg = (np.asarray(byts[:n], np.int64) if byts is not None
                     else o_pg << 22)
-            # vectorized per-OSD accumulation (the per-replica Python
-            # loop dominated crush-compat wall time at scale); float64
-            # bincount weights are exact below 2^53, far above any
-            # per-OSD byte total these sims produce
-            valid = (rows != ITEM_NONE) & (rows >= 0)
-            row_idx = np.nonzero(valid)[0]
-            osds = rows[valid].astype(np.int64)
-            minlen = int(osds.max()) + 1 if osds.size else 1
-            c_pgs = np.bincount(osds, minlength=minlen)
-            c_obj = np.bincount(
-                osds, weights=o_pg[row_idx].astype(np.float64),
-                minlength=minlen,
-            )
-            c_byt = np.bincount(
-                osds, weights=b_pg[row_idx].astype(np.float64),
-                minlength=minlen,
-            )
+            if ms.mapper == "jax":
+                # device-resident reduction: the rows never cross to the
+                # host, only the O(OSDs) count vectors do
+                c_pgs, c_obj, c_byt = ms.pool_counts(pid, o_pg, b_pg)
+            else:
+                rows = np.asarray(ms.pool_up(pid))[:n]
+                # vectorized per-OSD accumulation (the per-replica Python
+                # loop dominated crush-compat wall time at scale); float64
+                # bincount weights are exact below 2^53, far above any
+                # per-OSD byte total these sims produce
+                valid = (rows != ITEM_NONE) & (rows >= 0)
+                row_idx = np.nonzero(valid)[0]
+                osds = rows[valid].astype(np.int64)
+                minlen = int(osds.max()) + 1 if osds.size else 1
+                c_pgs = np.bincount(osds, minlength=minlen)
+                c_obj = np.bincount(
+                    osds, weights=o_pg[row_idx].astype(np.float64),
+                    minlength=minlen,
+                )
+                c_byt = np.bincount(
+                    osds, weights=b_pg[row_idx].astype(np.float64),
+                    minlength=minlen,
+                )
             present = np.nonzero(c_pgs)[0]
             cnt = {
                 "pgs": {int(o): int(c_pgs[o]) for o in present},

@@ -145,7 +145,8 @@ class Plan:
         self.osd_weights: dict[int, float] = {}
         # set by do_crush_compat on success: the accepted best state's
         # Eval, so callers need not re-map/re-score the final state
-        # (each re-score with the jax mapper is a full pipeline compile)
+        # (a re-score hits _PIPE_CACHE — no recompile since the weight
+        # tables became operands — but still re-maps every PG)
         self.final_eval: Eval | None = None
 
     def final_state(self) -> MappingState:

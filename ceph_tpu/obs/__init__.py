@@ -55,6 +55,35 @@ def prometheus_text() -> str:
     return _render(perf_dump())
 
 
+def jit_counters() -> dict:
+    """Flat compile/cache totals summed across perf groups: the
+    JitAccount `*_compiles` / `*_cache_hits` / `*_retraces` trios plus
+    the _PIPE_CACHE hit/miss pair.  Callers (bench stage records, the
+    cache-contract tests) diff two snapshots to get a per-phase delta."""
+    out = {"compiles": 0, "cache_hits": 0, "retraces": 0,
+           "pipe_cache_hits": 0, "pipe_cache_misses": 0}
+    for grp in perf_dump().values():
+        if not isinstance(grp, dict):
+            continue
+        for k, v in grp.items():
+            if not isinstance(v, int):
+                continue
+            if k in ("pipe_cache_hits", "pipe_cache_misses"):
+                out[k] += v
+            elif k.endswith("_compiles"):
+                out["compiles"] += v
+            elif k.endswith("_cache_hits"):
+                out["cache_hits"] += v
+            elif k.endswith("_retraces"):
+                out["retraces"] += v
+    return out
+
+
+def jit_counters_delta(before: dict) -> dict:
+    now = jit_counters()
+    return {k: now[k] - before[k] for k in now}
+
+
 maybe_start_from_env()
 
 __all__ = [
@@ -63,6 +92,8 @@ __all__ = [
     "counter",
     "flush",
     "instant",
+    "jit_counters",
+    "jit_counters_delta",
     "logger_for",
     "perf_dump",
     "perf_schema",

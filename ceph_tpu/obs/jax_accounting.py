@@ -69,6 +69,15 @@ class JitAccount:
         self.span_args = span_args
         self._seen: set[tuple] = set()
         logger.add_u64(f"{key}_compiles", "cold (trace+compile) calls")
+        logger.add_u64(
+            f"{key}_cache_hits",
+            "calls served by an already-compiled executable",
+        )
+        logger.add_u64(
+            f"{key}_retraces",
+            "recompiles beyond the first (new input signature on a warm "
+            "wrapper)",
+        )
         logger.add_time_avg(f"{key}_compile_seconds", "cold call wall time")
         logger.add_time_avg(
             f"{key}_dispatch_seconds", "steady-state dispatch wall time"
@@ -84,10 +93,13 @@ class JitAccount:
             out = self.fn(*args, **kw)
             dt = time.perf_counter() - t0
         if cold:
+            if self._seen:
+                self.log.inc(f"{self.key}_retraces")
             self._seen.add(sig)
             self.log.inc(f"{self.key}_compiles")
             self.log.observe(f"{self.key}_compile_seconds", dt)
         else:
+            self.log.inc(f"{self.key}_cache_hits")
             self.log.observe(f"{self.key}_dispatch_seconds", dt)
         return out
 

@@ -39,6 +39,7 @@ from ceph_tpu.crush.mapper_jax import (
     FAST_WINDOW_EXTRA,
     RESCUE_PAD,
     compile_rule,
+    device_tables,
 )
 from ceph_tpu.crush.soa import CrushArrays, build_arrays
 from ceph_tpu.crush.types import ITEM_NONE
@@ -47,13 +48,17 @@ from ceph_tpu.osd.osdmap import (
     MAX_PRIMARY_AFFINITY,
     OSDMap,
 )
-from ceph_tpu.osd.types import FLAG_HASHPSPOOL
+from ceph_tpu.osd.types import FLAG_HASHPSPOOL, PgId
 
 
 _L = obs.logger_for("pipeline")
 _L.add_u64("pgs_mapped", "placement seeds mapped through the batched pipeline")
 _L.add_u64("unresolved_pgs", "fast-window inconclusive lanes (exact-loop rescued)")
 _L.add_u64("rescue_invocations", "loop-kernel rescue passes")
+_L.add_u64("pipe_cache_hits",
+           "PoolMapper constructions served by _PIPE_CACHE (no new jit)")
+_L.add_u64("pipe_cache_misses",
+           "PoolMapper constructions that created a new jitted pipeline")
 
 
 def _h2(a, b):
@@ -220,6 +225,7 @@ def compile_pipeline(
     path: str = "auto",
     with_flag: bool = False,
     window_extra: int = FAST_WINDOW_EXTRA,
+    pool_operands: bool = False,
 ):
     """Build the single-PG mapping function for one pool; vmap/jit-ready.
 
@@ -235,6 +241,12 @@ def compile_pipeline(
     A small window_extra shrinks the fast kernel's candidate window —
     more lanes flag unresolved and rescue (the fast-window/rescue trade
     of PROFILE_r05 §5); exactness is unaffected.
+
+    pool_operands: read pool_id / pgp_num / pgp_mask from dev["pool"]
+    (u32 scalar operands; ceph_stable_mod is branchless so the trace is
+    identical for every value) instead of baking them — pools that share
+    structure (rule, size, osd bound, overlay gates) then share one
+    executable regardless of pool id or pg count (cache_key drops them).
     """
     W = spec.out_width
     R = spec.size
@@ -250,6 +262,10 @@ def compile_pipeline(
 
     def fn(ps, dev, ov):
         ps = jnp.asarray(ps).astype(jnp.uint32)
+        # per-map CRUSH tables ride in dev["crush"] as runtime operands
+        # (device_put once by PoolMapper.refresh_dev); absent — bare-fn
+        # callers — the kernel falls back to trace constants
+        tabs = dev.get("crush") if isinstance(dev, dict) else None
         exists = dev["exists"]  # bool[DV]
         upb = dev["up"]  # bool[DV]
         weight = dev["weight"]  # u32[DV]
@@ -260,21 +276,28 @@ def compile_pipeline(
             return (v >= 0) & (v < MO) & tbl[jnp.clip(v, 0, DV - 1)]
 
         # -- stage 1: placement seed (reference src/osd/osd_types.cc:1798) -
-        ps2 = stable_mod(ps, spec.pgp_num, pgp_mask, xp=jnp)
-        if spec.hashpspool:
-            pps = _h2(ps2, spec.pool_id & 0xFFFFFFFF)
+        if pool_operands:
+            pool = dev["pool"]  # u32 scalars: {pool_id, pgp_num, pgp_mask}
+            p_pgp, p_mask = pool["pgp_num"], pool["pgp_mask"]
+            p_id = pool["pool_id"]
         else:
-            pps = (ps2 + jnp.uint32(spec.pool_id)).astype(jnp.uint32)
+            p_pgp, p_mask = spec.pgp_num, pgp_mask
+            p_id = jnp.uint32(spec.pool_id & 0xFFFFFFFF)
+        ps2 = stable_mod(ps, p_pgp, p_mask, xp=jnp)
+        if spec.hashpspool:
+            pps = _h2(ps2, p_id)
+        else:
+            pps = (ps2 + p_id).astype(jnp.uint32)
 
         # -- stage 2: CRUSH (reference src/osd/OSDMap.cc:2444-2447) --------
         unresolved = jnp.bool_(False)
         if rule_fn is None:
             raw = jnp.full(W, ITEM_NONE, jnp.int32)
         elif with_flag:
-            raw, unresolved = rule_fn(pps, weight[:D])
+            raw, unresolved = rule_fn(pps, weight[:D], tabs)
             raw = _pad_lanes(raw, W)
         else:
-            raw = _pad_lanes(rule_fn(pps, weight[:D]), W)
+            raw = _pad_lanes(rule_fn(pps, weight[:D], tabs), W)
 
         # -- _remove_nonexistent_osds (reference src/osd/OSDMap.cc:2412) ---
         if spec.can_shift:
@@ -382,12 +405,38 @@ def compile_pipeline(
             return up, up_primary, acting, acting_primary, unresolved
         return up, up_primary, acting, acting_primary
 
+    # structural signature: everything baked into the trace above (pool
+    # statics, overlay gates, kernel path) + the CRUSH kernel's own
+    # cache_key.  Equal cache_keys <=> identical traces, so _PIPE_CACHE
+    # can hand the same jitted executable to any map that differs only
+    # in operand content (weights, osd state, choose_args values).
+    fn.cache_key = (
+        "pipe",
+        # with pool_operands the pool identity/pg counts are operands —
+        # structurally identical pools share the executable
+        (None if pool_operands else
+         (spec.pool_id, spec.pg_num, spec.pgp_num),
+         spec.size, spec.can_shift, spec.hashpspool, spec.ruleno,
+         spec.max_osd, spec.out_width),
+        with_upmap_full, n_upmap_pairs, with_temp, with_primary_temp,
+        with_primary_affinity, path, with_flag, window_extra,
+        pool_operands,
+        getattr(rule_fn, "cache_key", ("norule", spec.ruleno)),
+    )
+    fn.host_tables = getattr(rule_fn, "host_tables", {})
     return fn
 
 
 DEFAULT_CHUNK = 65536  # PG-axis block size: peak device memory for the
                        # fast kernel's [B, T, lanes] intermediates is
                        # O(chunk), never O(pg_num)
+
+# cache_key -> {"fast": JitAccount, "loop": JitAccount}.  The executables
+# are keyed on the pipeline's structural signature, so every balancer
+# iteration / upmap round / Incremental application — a fresh PoolMapper
+# over a map that differs only in weights, osd state, or choose_args
+# values — reuses one compile and only re-uploads operand tables.
+_PIPE_CACHE: dict[tuple, dict] = {}
 
 
 class PoolMapper:
@@ -396,6 +445,11 @@ class PoolMapper:
     Usage:
         pm = PoolMapper(osdmap, pool_id)
         up, up_primary, acting, acting_primary = pm.map_all()
+
+    Trace-once contract: constructing a PoolMapper never recompiles if a
+    structurally-identical pipeline (same `cache_key`) was jitted before
+    in this process — the per-map tables are runtime operands
+    (device_put once here, carried in self.dev["crush"]).
     """
 
     def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True,
@@ -420,14 +474,25 @@ class PoolMapper:
             with_primary_temp=self.ov.primary_temp is not None,
             with_primary_affinity=m.osd_primary_affinity is not None,
         )
+        # self.fn is the exact (loop) kernel: path="auto" without a flag
+        # resolves to the loop path in compile_rule, so it doubles as the
+        # rescue kernel (jitted_loop)
         self.fn = compile_pipeline(
             self.arrays, self.spec, path=path,
-            window_extra=window_extra, **self._pipe_kw
+            window_extra=window_extra, pool_operands=True, **self._pipe_kw
         )
         self._fast = compile_pipeline(
             self.arrays, self.spec, path=path, with_flag=True,
-            window_extra=window_extra, **self._pipe_kw,
+            window_extra=window_extra, pool_operands=True, **self._pipe_kw,
         )
+        # one device_put of this map's tables (fast ⊇ loop: same base
+        # tables, plus the row-level tables only the fast path reads)
+        self._tables_dev = (
+            device_tables(self._fast.host_tables)
+            if self._fast.host_tables else None
+        )
+        self.cache_key = (self._fast.cache_key, self.fn.cache_key)
+        self._cache = _PIPE_CACHE.setdefault(self.cache_key, {})
         self.refresh_dev()
         self._jitted = None
         self._jloop = None
@@ -437,7 +502,9 @@ class PoolMapper:
         """(Re)build the padded per-OSD vectors from the map's current
         osd state/weight/affinity — cheap O(OSDs) work, so callers that
         reuse a compiled PoolMapper across weight changes (the balancer's
-        round cache) can refresh instead of recompiling."""
+        round cache) can refresh instead of recompiling.  The CRUSH
+        operand tables (device-put once at construction) ride along in
+        dev["crush"]."""
         dv = self.m.frozen_vectors()
         DV = max(self.arrays.max_devices, self.m.max_osd, 1)
         self.dev = {
@@ -447,31 +514,46 @@ class PoolMapper:
             "primary_affinity": _pad_to(
                 dv["primary_affinity"], DV, DEFAULT_PRIMARY_AFFINITY
             ),
+            # pool identity as u32 scalar operands (pool_operands=True):
+            # structurally-equal pools dispatch the same executable
+            "pool": {
+                "pool_id": jnp.uint32(self.spec.pool_id & 0xFFFFFFFF),
+                "pgp_num": jnp.uint32(self.spec.pgp_num),
+                "pgp_mask": jnp.uint32(pg_mask_for(self.spec.pgp_num)),
+            },
         }
+        if self._tables_dev is not None:
+            self.dev["crush"] = self._tables_dev
+
+    def _cached_jit(self, kind: str, fn):
+        acct = self._cache.get(kind)
+        if acct is None:
+            _L.inc("pipe_cache_misses")
+            acct = obs.JitAccount(
+                jax.jit(jax.vmap(fn, in_axes=(0, None, 0))), _L, kind,
+            )
+            self._cache[kind] = acct
+        else:
+            _L.inc("pipe_cache_hits")
+        return acct
 
     def jitted_fast(self):
         """The jitted vmapped fast pipeline (with unresolved flag); one
-        trace cache shared by map_batch and external batch drivers.
+        trace cache shared by map_batch and external batch drivers, AND
+        across PoolMapper instances with equal cache_key (_PIPE_CACHE).
         Wrapped in compile/dispatch accounting (obs.JitAccount): the
         perf dump separates `fast_compile_seconds` (first call per block
-        shape) from `fast_dispatch_seconds`."""
+        shape) from `fast_dispatch_seconds`, and counts `fast_compiles` /
+        `fast_cache_hits` / `fast_retraces`."""
         if self._jitted is None:
-            self._jitted = obs.JitAccount(
-                jax.jit(jax.vmap(self._fast, in_axes=(0, None, 0))),
-                _L, "fast",
-            )
+            self._jitted = self._cached_jit("fast", self._fast)
         return self._jitted
 
     def jitted_loop(self):
-        """The jitted vmapped exact loop pipeline (rescue kernel)."""
+        """The jitted vmapped exact loop pipeline (rescue kernel) —
+        self.fn, shared through _PIPE_CACHE like the fast kernel."""
         if self._jloop is None:
-            loop_fn = compile_pipeline(
-                self.arrays, self.spec, path="loop", **self._pipe_kw
-            )
-            self._jloop = obs.JitAccount(
-                jax.jit(jax.vmap(loop_fn, in_axes=(0, None, 0))),
-                _L, "loop",
-            )
+            self._jloop = self._cached_jit("loop", self.fn)
         return self._jloop
 
     def _ov_rows(self, ps: np.ndarray) -> dict:
@@ -531,33 +613,39 @@ class PoolMapper:
             raise
 
     def _map_block_inner(self, ps: np.ndarray, n: int):
+        # span contract (tools/check_no_host_sync.py): map_block and
+        # rescue time DISPATCH only — no np.asarray/.item()/float() on
+        # traced values inside them.  The unresolved-flag fetch sits
+        # between the spans; result rows stay on device (rescued lanes
+        # scattered in with .at[].set) until pipeline.fetch.
         with obs.span("pipeline.map_block", pgs=n):
             *out, flg = self.jitted_fast()(
                 jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
             )
-            flg = obs.timed_fetch(_L, "result", flg)
-            _L.inc("pgs_mapped", n)
-            if flg.any():
-                idx = np.nonzero(flg)[0]
-                _L.inc("unresolved_pgs", int((idx < n).sum()))
-                _L.inc("rescue_invocations")
-                with obs.span("pipeline.rescue", lanes=len(idx)):
-                    jloop = self.jitted_loop()
-                    out = [np.array(o) for o in out]  # writable copies
-                    P = RESCUE_PAD
-                    for i in range(0, len(idx), P):
-                        blk = idx[i:i + P]
-                        # cycle-pad: one compile per shape
-                        pad = np.resize(blk, P)
-                        sub = jloop(
-                            jnp.asarray(ps[pad], np.uint32), self.dev,
-                            self._ov_rows(ps[pad]),
-                        )
-                        for o, s in zip(out, sub):
-                            o[blk] = np.asarray(s)[: len(blk)]
-                    return tuple(out)
-            with obs.span("pipeline.fetch"):
-                return tuple(np.asarray(o) for o in out)
+        flg = obs.timed_fetch(_L, "result", flg)
+        _L.inc("pgs_mapped", n)
+        if flg.any():
+            idx = np.nonzero(flg)[0]
+            _L.inc("unresolved_pgs", int((idx < n).sum()))
+            _L.inc("rescue_invocations")
+            jloop = self.jitted_loop()
+            with obs.span("pipeline.rescue", lanes=len(idx)):
+                P = RESCUE_PAD
+                for i in range(0, len(idx), P):
+                    blk = idx[i:i + P]
+                    # cycle-pad: one compile per shape
+                    pad = np.resize(blk, P)
+                    sub = jloop(
+                        jnp.asarray(ps[pad], np.uint32), self.dev,
+                        self._ov_rows(ps[pad]),
+                    )
+                    bidx = jnp.asarray(blk)
+                    out = [
+                        o.at[bidx].set(s[: len(blk)])
+                        for o, s in zip(out, sub)
+                    ]
+        with obs.span("pipeline.fetch"):
+            return tuple(np.asarray(o) for o in out)
 
     def map_all(self):
         return self.map_batch(np.arange(self.spec.pg_num, dtype=np.uint32))
@@ -596,10 +684,10 @@ class PoolMapper:
         if int(nflg):
             _L.inc("rescue_invocations")
             vloop = self.jitted_loop()
+            flag_vs = [np.asarray(f) for f in flgs]  # fetched pre-span
             n_unres = 0
             with obs.span("pipeline.rescue", lanes=int(nflg)):
-                for bi, f in enumerate(flgs):
-                    fv = np.asarray(f)
+                for bi, fv in enumerate(flag_vs):
                     if not fv.any():
                         continue
                     idx = np.nonzero(fv)[0] + bi * B
@@ -614,6 +702,26 @@ class PoolMapper:
                         rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
             _L.inc("unresolved_pgs", n_unres)
         return rows
+
+
+def overlay_fixup_rows(m: OSDMap, pool_id: int, width: int):
+    """Host-exact `up` rows for the PGs of `pool_id` that carry a
+    pg_upmap / pg_upmap_items entry: (seeds i64[K], rows i32[K, width]),
+    both empty when the pool has none.  The overlay-free device paths
+    (map_all_device and its callers — mgr eval, balancer DeviceState,
+    upmap's pgs_by_osd) skip the dense overlay tensors so accumulating
+    entries never change the compiled shape; they scatter these few
+    oracle rows in instead, bit-identical to the overlay-gated kernel."""
+    n = m.pools[pool_id].pg_num
+    seeds = sorted({
+        pg.seed for pg in list(m.pg_upmap) + list(m.pg_upmap_items)
+        if pg.pool == pool_id and pg.seed < n
+    })
+    rows = np.full((len(seeds), width), ITEM_NONE, np.int32)
+    for i, s in enumerate(seeds):
+        up, _, _, _ = m.pg_to_up_acting_osds(PgId(pool_id, s))
+        rows[i, : min(len(up), width)] = up[:width]
+    return np.asarray(seeds, np.int64), rows
 
 
 def map_cluster(m: OSDMap) -> dict[int, tuple]:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ceph_tpu.core import reduce
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd.pipeline_jax import PoolMapper
 
@@ -74,13 +75,11 @@ def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
 
 
 def _hist(ids, n, extra_mask=None):
-    """Per-OSD counts via scatter-add; invalid lanes (ITEM_NONE pads and
-    -1 no-primary markers) fall off the end."""
-    valid = (ids != ITEM_NONE) & (ids >= 0)
-    if extra_mask is not None:
-        valid = valid & extra_mask
-    idx = jnp.where(valid, jnp.clip(ids, 0, n - 1), n)
-    return jnp.zeros(n + 1, jnp.int32).at[idx.reshape(-1)].add(1)[:n]
+    """Per-OSD counts via scatter-add (the shared device reduction from
+    ceph_tpu.core.reduce; traceable inside the shard_map bodies below —
+    invalid lanes, ITEM_NONE pads and -1 no-primary markers, fall off
+    the end)."""
+    return reduce.osd_histogram(ids, n, extra_mask)
 
 
 class ShardedClusterMapper:

@@ -137,6 +137,11 @@ class Stage:
     est_s: float = 30.0  # cost estimate (budgeting)
     min_budget_s: float | None = None  # default: est_s
     soft_timeout_s: float | None = None  # None = rest of the deadline
+    reserve_s: float = 0.0  # deadline left for LATER stages: the
+    # watchdog abandons this stage early enough that reserve_s of
+    # wall-clock survives it (a cooperative in-stage budget check can't
+    # help when a single step overruns, cf. BENCH r06: a 10M-PG round
+    # ate the whole deadline before its first between-rounds check)
     order: int = 0  # declaration order (priority tiebreak)
 
 
@@ -173,11 +178,12 @@ class StageScheduler:
 
     def add(self, name: str, fn, *, priority: int = 50, est_s: float = 30.0,
             min_budget_s: float | None = None,
-            soft_timeout_s: float | None = None) -> None:
+            soft_timeout_s: float | None = None,
+            reserve_s: float = 0.0) -> None:
         self.stages.append(Stage(
             name, fn, priority=priority, est_s=est_s,
             min_budget_s=min_budget_s, soft_timeout_s=soft_timeout_s,
-            order=len(self.stages),
+            reserve_s=reserve_s, order=len(self.stages),
         ))
 
     def remaining(self) -> float:
@@ -223,7 +229,16 @@ class StageScheduler:
             except BaseException as e:  # checkpointed, not swallowed
                 box["error"] = e
 
-        timeout = min(st.soft_timeout_s or rem, rem)
+        timeout = min(st.soft_timeout_s or rem, rem - st.reserve_s, rem)
+        if timeout <= 0:
+            L.inc("stages_skipped_budget")
+            self.checkpoint.put(f"{st.name}_skipped", {
+                "remaining_s": round(rem, 1),
+                "needed_s": st.reserve_s,
+            })
+            _log(1, f"stage {st.name}: skipped, {rem:.0f}s left <= "
+                    f"{st.reserve_s:.0f}s reserved for later stages")
+            return
         t = threading.Thread(
             target=target, name=f"stage-{st.name}", daemon=True
         )

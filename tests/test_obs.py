@@ -336,6 +336,41 @@ def test_no_print_lint_catches_violation(tmp_path):
     assert len(v) == 2  # stderr print is allowed
 
 
+# -- dispatch spans never host-sync ----------------------------------------
+
+def test_no_host_sync_lint():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_no_host_sync.py")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_no_host_sync_lint_catches_violation(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_no_host_sync import check_file
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "with obs.span('pipeline.map_block', pgs=1):\n"
+        "    a = np.asarray(x)\n"
+        "    b = x.item()\n"
+        "    c = float(x)\n"
+        "with obs.span('pipeline.rescue'):\n"
+        "    d = np.resize(x, 4)\n"       # not a sync: allowed
+        "    e = np.array(x)\n"
+        "with obs.span('pipeline.fetch'):\n"
+        "    f = np.asarray(x)\n"          # fetch span: allowed
+        "with span('pipeline.map_block'):\n"
+        "    g = np.asarray(x)\n"          # bare span() counts too
+    )
+    v = check_file(bad)
+    assert len(v) == 5, v
+
+
 # -- satellite: pytest must not collect TesterConfig -----------------------
 
 def test_tester_config_not_collected():

@@ -1,0 +1,373 @@
+"""Trace-once pipeline contract: the compiled-pipeline cache and the
+constant-folding regression guard.
+
+Per-map data (bucket tables, straw2 planes, osd weight/state vectors)
+rides as runtime operands; only structural facts are baked into the
+trace and summarized by `fn.cache_key`.  So:
+
+  * two maps that differ only in weights / osd state / choose_args
+    VALUES share one compiled executable through _PIPE_CACHE — zero new
+    XLA compiles (the balancer-iteration shape);
+  * shape / rule / tunable changes produce different cache_keys (a miss
+    is correct — the trace really differs);
+  * the traced program embeds no table-sized literal, so XLA never
+    constant-folds a [65536, ...] pred tensor again (BENCH_r05 burned
+    >2s per compile on exactly that).
+
+Counter-based assertions use deltas (the perf registry and _PIPE_CACHE
+are process-global and other tests may have warmed them).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.osdmap import build_hierarchical
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ceph_tpu import obs  # noqa: E402
+from ceph_tpu.crush.types import ITEM_NONE  # noqa: E402
+from ceph_tpu.osd.pipeline_jax import (  # noqa: E402
+    PoolMapper,
+    PoolSpec,
+    compile_pipeline,
+)
+
+
+def _mk_map(n_pgs, n_osds=64, per_host=8):
+    pool = PgPool(
+        type=PoolType.REPLICATED, size=3, crush_rule=0,
+        pg_num=n_pgs, pgp_num=n_pgs,
+    )
+    n_host = max(1, n_osds // per_host)
+    return build_hierarchical(
+        n_host, per_host, n_rack=max(1, n_host // 4), pool=pool
+    )
+
+
+_jit_counters = obs.jit_counters
+_delta = obs.jit_counters_delta
+
+
+# -- cache_key semantics (no jit, cheap) ------------------------------------
+
+def test_cache_key_ignores_weights_and_choose_args_values():
+    """Weight / choose-args VALUE changes keep the structural signature."""
+    from ceph_tpu.crush.soa import build_arrays
+
+    m1 = _mk_map(512)
+    m2 = _mk_map(512)
+    for o in (1, 5, 9):
+        m2.osd_weight[o] = int(0x10000 * 0.5)
+    # a compat weight-set on m2 only: still values, not structure
+    from ceph_tpu.mgr.module import compat_ws_to_choose_args
+
+    m2.crush.choose_args[-1] = compat_ws_to_choose_args(
+        m2.crush, {o: 1.0 for o in range(m2.max_osd)}
+    )
+    keys = []
+    for m in (m1, m2):
+        ca = m.crush.choose_args.get(0, m.crush.choose_args.get(-1))
+        A = build_arrays(m.crush, ca)
+        spec = PoolSpec.for_pool(m, 0)
+        keys.append(compile_pipeline(A, spec).cache_key)
+    assert keys[0] == keys[1]
+
+
+def test_cache_key_misses_on_structural_change():
+    """pg_num / tunables / rule changes MUST change the key."""
+    from ceph_tpu.crush.soa import build_arrays
+
+    def key_of(m):
+        A = build_arrays(m.crush, None)
+        return compile_pipeline(A, PoolSpec.for_pool(m, 0)).cache_key
+
+    base = key_of(_mk_map(512))
+    assert key_of(_mk_map(640)) != base  # pg_num
+    mt = _mk_map(512)
+    mt.crush.tunables.choose_total_tries += 7
+    assert key_of(mt) != base  # tunables
+    mw = _mk_map(512)
+    mw.pools[0].size = 2  # numrep/out width
+    assert key_of(mw) != base
+
+
+def test_pool_operands_key_spans_pools():
+    """With pool_operands the pool identity / pg counts are u32 operands:
+    pools sharing rule/size/osd-bound share the key (tunables still
+    miss — the trace really differs)."""
+    from ceph_tpu.crush.soa import build_arrays
+
+    def key_of(m):
+        A = build_arrays(m.crush, None)
+        return compile_pipeline(
+            A, PoolSpec.for_pool(m, 0), pool_operands=True
+        ).cache_key
+
+    base = key_of(_mk_map(512))
+    assert key_of(_mk_map(640)) == base  # pg_num is an operand now
+    mt = _mk_map(512)
+    mt.crush.tunables.choose_total_tries += 7
+    assert key_of(mt) != base
+
+
+@pytest.mark.slow
+def test_cross_pool_sharing_zero_compiles():
+    """Two maps whose pools differ in pg_num (and hence pps math inputs)
+    dispatch the SAME executable at a fixed block shape — zero compiles,
+    rows bit-exact per pool (the testmappgs/headline bench sharing)."""
+    n1, n2 = 1100, 1900
+    pm1 = PoolMapper(_mk_map(n1), 0, chunk=512)
+    pm1.map_all()
+    m2 = _mk_map(n2)
+    c0 = _jit_counters()
+    pm2 = PoolMapper(m2, 0, chunk=512)
+    up2, _, _, _ = pm2.map_all()
+    d = _delta(c0)
+    assert d["compiles"] == 0 and d["retraces"] == 0, d
+    assert d["pipe_cache_hits"] >= 1, d
+    for s in range(0, n2, 173):
+        want, _, _, _ = m2.pg_to_up_acting_osds(PgId(0, s))
+        got = [int(x) for x in up2[s] if x != ITEM_NONE]
+        assert got == list(want), (s, got, want)
+
+
+# -- executable sharing through _PIPE_CACHE ---------------------------------
+
+def _warm_both_kernels(pm: PoolMapper):
+    """Compile fast AND loop kernels at the full-pool block shape so
+    later deltas isolate executable reuse (jax compiles per shape; the
+    loop kernel otherwise compiles lazily on the first rescue)."""
+    from ceph_tpu.crush.mapper_jax import RESCUE_PAD
+
+    pm.map_all()
+    ps = np.zeros(RESCUE_PAD, np.uint32)
+    pm.jitted_loop()(jnp.asarray(ps), pm.dev, {})
+
+
+def test_same_shape_weight_change_hits_pipe_cache():
+    """The exact shape of a balancer iteration: same structure, new
+    weights -> 0 new compiles, 0 retraces, rows still bit-exact."""
+    n = 832  # tier-1 budget: small map; compile cost is size-independent
+    _warm_both_kernels(PoolMapper(_mk_map(n), 0))
+    m2 = _mk_map(n)
+    for o in (3, 7, 11, 40):
+        m2.osd_weight[o] = int(0x10000 * 0.7)
+    c0 = _jit_counters()
+    pm2 = PoolMapper(m2, 0)
+    up, _, _, _ = pm2.map_batch(np.arange(n, dtype=np.uint32))
+    d = _delta(c0)
+    assert d["compiles"] == 0, d
+    assert d["retraces"] == 0, d
+    assert d["pipe_cache_hits"] >= 2, d  # fast + loop JitAccounts reused
+    assert d["pipe_cache_misses"] == 0, d
+    for s in range(0, n, 131):  # spot-check against the host oracle
+        want, _, _, _ = m2.pg_to_up_acting_osds(PgId(0, s))
+        got = [int(x) for x in up[s] if x != ITEM_NONE]
+        assert got == list(want), (s, got, want)
+
+
+@pytest.mark.slow
+def test_structural_change_misses_pipe_cache():
+    """Counter-level form of the key-miss test (a real second compile —
+    slow; the cache_key inequality itself is tier-1 above)."""
+    n = 1248
+    mt = _mk_map(n)
+    mt.crush.tunables.choose_total_tries += 5
+    c0 = _jit_counters()
+    PoolMapper(mt, 0).map_batch(np.arange(256, dtype=np.uint32))
+    d = _delta(c0)
+    assert d["pipe_cache_misses"] >= 1, d
+    assert d["compiles"] >= 1, d
+
+
+# -- the acceptance shape: a crush-compat round -----------------------------
+
+def test_crush_compat_compiles_only_in_iteration_one():
+    """ISSUE 5 acceptance: a 3-iteration do_crush_compat round on a
+    same-shape map reports exactly the compile count of iteration 1 —
+    every weight-set re-score past the first is a cache hit (the
+    weight-set values are operands, not new traces)."""
+    from ceph_tpu.mgr import Balancer, MappingState, synthetic_pg_stats
+
+    snaps = []
+
+    class CountingBalancer(Balancer):
+        def eval(self, ms, pools=None):
+            r = super().eval(ms, pools)
+            snaps.append(_jit_counters())
+            return r
+
+    m = _mk_map(1024)
+    rng = np.random.default_rng(7)
+    for o in rng.choice(64, 4, replace=False):
+        m.osd_weight[int(o)] = int(0x10000 * 0.8)
+    bal = CountingBalancer(
+        options={"crush_compat_max_iterations": 3},
+        rng=np.random.default_rng(17),
+    )
+    ms = MappingState(m, synthetic_pg_stats(m), mapper="jax")
+    plan = bal.plan_create("t", ms, mode="crush-compat")
+    rc, detail = bal.optimize(plan)
+    assert rc == 0, detail
+    # snaps[0] = initial score, snaps[1..] = one per loop iteration
+    assert len(snaps) >= 4, len(snaps)  # 3 full iterations ran
+    it1, final = snaps[1], snaps[-1]
+    assert final["compiles"] == it1["compiles"], snaps
+    assert final["retraces"] == it1["retraces"], snaps
+    # and the later iterations really went through the caches
+    assert final["cache_hits"] > it1["cache_hits"], snaps
+    assert final["pipe_cache_hits"] > it1["pipe_cache_hits"], snaps
+
+
+@pytest.mark.slow
+def test_upmap_round_compiles_once_per_shape():
+    """A do_upmap optimize round on a warmed structure: zero compiles
+    (the overlay-free eval kernel is shared; accumulated pg_upmap
+    entries are host fixups, not new traces)."""
+    from ceph_tpu.mgr import Balancer, MappingState, synthetic_pg_stats
+
+    n = 1408
+    m = _mk_map(n)
+    rng = np.random.default_rng(11)
+    for o in rng.choice(64, 4, replace=False):
+        m.osd_weight[int(o)] = int(0x10000 * 0.75)
+    _warm_both_kernels(PoolMapper(m, 0, overlays=False))
+    bal = Balancer(
+        options={"upmap_max_optimizations": 8},
+        rng=np.random.default_rng(3),
+    )
+    ms = MappingState(m, synthetic_pg_stats(m), mapper="jax")
+    c0 = _jit_counters()
+    plan = bal.plan_create("t", ms, mode="upmap")
+    rc, detail = bal.optimize(plan)
+    bal.eval(plan.final_state())  # re-score the result as well
+    d = _delta(c0)
+    assert d["compiles"] == 0, (rc, detail, d)
+    assert d["retraces"] == 0, d
+
+
+# -- constant-folding regression guard --------------------------------------
+
+MAX_LITERAL = 4096
+
+
+def _collect_consts(j, acc):
+    for c in getattr(j, "consts", ()):
+        acc.append(c)
+    core = getattr(j, "jaxpr", j)
+    for eqn in core.eqns:
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for w in vs:
+                if hasattr(w, "eqns") or hasattr(w, "jaxpr"):
+                    _collect_consts(w, acc)
+    return acc
+
+
+def _big_consts(jaxpr):
+    return [
+        tuple(c.shape) for c in _collect_consts(jaxpr, [])
+        if getattr(c, "size", 0) > MAX_LITERAL
+    ]
+
+
+def test_no_table_literals_in_headline_trace():
+    """The headline-shaped pipeline (1024 OSDs) traces fast and embeds
+    NO table-sized literal: every table >4096 elements is an operand.
+    (BENCH_r05: XLA spent >2s constant-folding a pred[65536,11] literal
+    per compile; a baked table would reappear here as a giant const.)"""
+    m = _mk_map(4096, n_osds=1024, per_host=16)
+    pm = PoolMapper(m, 0, overlays=False)
+    vfast = jax.vmap(pm._fast, in_axes=(0, None, 0))
+    t0 = time.monotonic()
+    jaxpr = jax.make_jaxpr(vfast)(
+        jnp.zeros(65536, jnp.uint32), pm.dev, {}
+    )
+    trace_s = time.monotonic() - t0
+    assert trace_s < 30.0, f"trace took {trace_s:.1f}s"
+    assert _big_consts(jaxpr) == []
+
+
+def test_guard_detects_baked_tables():
+    """Negative control: the legacy bare-fn path (no operand pytree)
+    bakes the tables as trace constants — the guard must see them, or
+    the positive test above proves nothing."""
+    m = _mk_map(512, n_osds=1024, per_host=16)
+    pm = PoolMapper(m, 0, overlays=False)
+    dev = {k: v for k, v in pm.dev.items() if k != "crush"}
+    vfast = jax.vmap(pm._fast, in_axes=(0, None, 0))
+    jaxpr = jax.make_jaxpr(vfast)(jnp.zeros(512, jnp.uint32), dev, {})
+    assert _big_consts(jaxpr) != []
+
+
+# -- EC GF tables: one device_put per backend -------------------------------
+
+def test_gf_device_tables_cached_per_backend():
+    from ceph_tpu.ec.gf import _DEV_TABLES, gf_device_tables
+
+    t1 = gf_device_tables()
+    t2 = gf_device_tables()
+    assert t1 is t2  # same dict object: no re-upload
+    assert set(t1) == {"exp", "log", "mul"}
+    b = jax.default_backend()
+    assert _DEV_TABLES[b] is t1
+    assert t1["exp"].shape == (512,)
+    assert t1["mul"].shape == (256, 256)
+
+
+def test_gf_logexp_kernel_uses_cached_tables():
+    """Two encodes with different matrices share the device tables (the
+    r05 gap: per-call re-upload of log/exp on every retrace)."""
+    from ceph_tpu.ec.gf import gf_device_tables
+    from ceph_tpu.ec.jax_backend import JaxEngine, _matmul_logexp
+
+    gft = gf_device_tables()
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(4, 1024), dtype=np.uint8)
+    M = np.array([[1, 1, 1, 1], [1, 2, 4, 8]], dtype=np.uint8)
+    mt = tuple(tuple(int(c) for c in r) for r in M)
+    out = np.asarray(_matmul_logexp(mt, jnp.asarray(data),
+                                    gft["exp"], gft["log"]))
+    # reference via the numpy mul table
+    from ceph_tpu.ec.gf import GF_MUL_TABLE
+
+    want = np.zeros((2, 1024), np.uint8)
+    for i in range(2):
+        acc = np.zeros(1024, np.uint8)
+        for j in range(4):
+            acc ^= GF_MUL_TABLE[M[i, j], data[j]]
+        want[i] = acc
+    np.testing.assert_array_equal(out, want)
+    assert gf_device_tables() is gft  # still the same upload
+
+
+# -- heavy variant ----------------------------------------------------------
+
+@pytest.mark.slow
+def test_weight_change_zero_compiles_at_scale():
+    """65536 PGs / 256 OSDs: a reweighted same-shape map re-maps with
+    zero compiles and the rows match the fresh-compile result."""
+    n = 65536
+    m1 = _mk_map(n, n_osds=256, per_host=8)
+    pm1 = PoolMapper(m1, 0, overlays=False)
+    _warm_both_kernels(pm1)
+    pm1.map_all_device()
+    m2 = _mk_map(n, n_osds=256, per_host=8)
+    rng = np.random.default_rng(23)
+    for o in rng.choice(256, 16, replace=False):
+        m2.osd_weight[int(o)] = int(0x10000 * 0.6)
+    c0 = _jit_counters()
+    rows = np.asarray(PoolMapper(m2, 0, overlays=False).map_all_device())
+    d = _delta(c0)
+    assert d["compiles"] == 0, d
+    for s in range(0, n, 4099):
+        want, _, _, _ = m2.pg_to_up_acting_osds(PgId(0, s))
+        got = [int(x) for x in rows[s] if x != ITEM_NONE]
+        assert got == list(want), (s, got, want)

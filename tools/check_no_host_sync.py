@@ -1,0 +1,132 @@
+"""Lint: no host syncs inside the pipeline dispatch spans.
+
+`pipeline.map_block` and `pipeline.rescue` spans time DISPATCH — the
+enqueue of already-compiled work onto the device.  A `np.asarray(...)`,
+`.item()` or `float(...)` on a traced value inside one of those bodies
+blocks on the device and silently turns the span into a transfer
+measurement (the exact bug that made r05's per-block numbers
+fetch-bound); the fetch belongs in `pipeline.fetch` (or between the
+spans, as the unresolved-flag read in PoolMapper._map_block_inner does).
+
+This lint walks the AST of every hot-path module plus bench.py and
+flags, inside any `with obs.span("pipeline.map_block"...)` /
+`obs.span("pipeline.rescue"...)` body:
+
+    np.asarray(...) / np.array(...) / numpy.asarray(...)
+    <expr>.item()
+    float(...)
+
+The check is syntactic — it cannot prove an operand is traced — so
+host-only work belongs *outside* the span (hoist it; every current call
+site needs nothing inside but dispatches and device-side scatters).
+
+Runnable standalone (exit 1 on violations) and from tests:
+
+    python tools/check_no_host_sync.py
+    from check_no_host_sync import find_violations
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+SPAN_NAMES = ("pipeline.map_block", "pipeline.rescue")
+
+SCAN = (
+    "ceph_tpu",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+
+def _span_name(item: ast.withitem) -> str | None:
+    """The span name if this with-item is obs.span("...")/span("...")."""
+    c = item.context_expr
+    if not isinstance(c, ast.Call) or not c.args:
+        return None
+    f = c.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name != "span":
+        return None
+    a0 = c.args[0]
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value
+    return None
+
+
+def _sync_call(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "item" and not node.args:
+            return ".item()"
+        if (
+            f.attr in ("asarray", "array")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy")
+        ):
+            return f"{f.value.id}.{f.attr}()"
+    elif isinstance(f, ast.Name) and f.id == "float":
+        return "float()"
+    return None
+
+
+def check_file(path: Path) -> list[str]:
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: unparseable: {e.msg}"]
+    rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        spans = [
+            s for s in (_span_name(i) for i in node.items)
+            if s in SPAN_NAMES
+        ]
+        if not spans:
+            continue
+        for sub in node.body:
+            for call in ast.walk(sub):
+                if isinstance(call, ast.Call):
+                    what = _sync_call(call)
+                    if what:
+                        out.append(
+                            f"{rel}:{call.lineno}: {what} inside a "
+                            f"{spans[0]} span (host sync; fetch belongs "
+                            "in pipeline.fetch)"
+                        )
+    return out
+
+
+def find_violations(root: Path = REPO) -> list[str]:
+    out: list[str] = []
+    for entry in SCAN:
+        p = root / entry
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for py in files:
+            if py.exists():
+                out.extend(check_file(py))
+    return out
+
+
+def main() -> int:
+    violations = find_violations()
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(f"check_no_host_sync: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_no_host_sync: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
