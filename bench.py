@@ -12,9 +12,13 @@ Measures, on the default jax device (the real TPU chip when present):
    612-623) compiled from the read-only reference mount.
 
 2. EC throughput (BASELINE.md configs 3-4): RS(k=8,m=4) encode/decode GB/s
-   on the device engine (ec.jax_backend) and the native SIMD engine
-   (reference tool: src/test/erasure-code/ceph_erasure_code_benchmark.cc:
-   156-317), plus Clay(8,4,d=11) single-chunk repair bandwidth.
+   on the device engine (ec.jax_backend: per-profile × per-strategy
+   table, measured autotune pick, batched-stripe rates, XOR-schedule
+   stats, and the jit cache-counter proof of 0 compiles across stripes
+   and warmed erasure patterns — see bench_ec_jax) and the native SIMD
+   engine (reference tool:
+   src/test/erasure-code/ceph_erasure_code_benchmark.cc:156-317), plus
+   Clay(8,4,d=11) single-chunk repair bandwidth.
 
 Survivability design (this file prints ONE JSON line, always, rc=0),
 built on ceph_tpu.runtime:
@@ -54,7 +58,8 @@ BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU,
 BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
 second mapping config, selftest), BENCH_BAL_PGS/_OSDS/_COMPAT_ITERS
 (balancer stage), plus the CEPH_TPU_FAULTS / CEPH_TPU_LADDER /
-CEPH_TPU_INIT_* runtime knobs.
+CEPH_TPU_INIT_* runtime knobs and CEPH_TPU_EC_STRATEGY (forces one
+ec.jax_backend strategy; the ec_jax stage measures all of them anyway).
 """
 
 from __future__ import annotations
@@ -414,13 +419,9 @@ def _time_engine(fn, reps=REPS) -> float:
 
 
 def bench_ec_engine(name: str, profile: dict) -> dict:
-    """RS(8,4) encode + 2-erasure decode GB/s for one engine (reference
-    prints seconds/KiB: ceph_erasure_code_benchmark.cc:176-184).
-
-    For the device engine the stripes are DEVICE-RESIDENT across calls
-    (HBM is the TPU's RAM exactly as the reference benchmark's buffers
-    live in host RAM); completion is forced by fetching a tiny result
-    slice, so the rate measures encode work, not tunnel I/O."""
+    """RS(8,4) encode + 2-erasure decode GB/s for one HOST engine
+    (reference prints seconds/KiB: ceph_erasure_code_benchmark.cc:
+    176-184).  The device engine has its own stage (bench_ec_jax)."""
     from ceph_tpu.ec.registry import create_erasure_code
 
     k, mm = 8, 4
@@ -429,36 +430,209 @@ def bench_ec_engine(name: str, profile: dict) -> dict:
     data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
     total = k * L
     code = create_erasure_code(dict(profile))
-    if profile.get("backend") == "jax" or profile.get("plugin") == "jax":
-        import jax
-        import jax.numpy as jnp
-
-        ddata = jax.device_put(jnp.asarray(data))
-
-        def enc():
-            out = code.encode_chunks(ddata)
-            np.asarray(out[-1, :64])  # tiny fetch forces the whole buffer
-
-        enc_s = _time_engine(enc)
-        encoded = code.encode_chunks(ddata)
-        chunks = {i: encoded[i] for i in range(k + mm) if i not in (0, 5)}
-
-        def dec():
-            out = code.decode_chunks({0, 5}, dict(chunks), L)
-            np.asarray(out[0][:64])
-
-        dec_s = _time_engine(dec)
-    else:
-        enc_s = _time_engine(lambda: code.encode_chunks(data))
-        encoded = code.encode_chunks(data)
-        chunks = {i: encoded[i] for i in range(k + mm) if i not in (0, 5)}
-        dec_s = _time_engine(
-            lambda: code.decode_chunks({0, 5}, dict(chunks), L)
-        )
+    enc_s = _time_engine(lambda: code.encode_chunks(data))
+    encoded = code.encode_chunks(data)
+    chunks = {i: encoded[i] for i in range(k + mm) if i not in (0, 5)}
+    dec_s = _time_engine(
+        lambda: code.decode_chunks({0, 5}, dict(chunks), L)
+    )
     return {
         f"rs84_encode_gbps_{name}": round(total / enc_s / 1e9, 3),
         f"rs84_decode2_gbps_{name}": round(total / dec_s / 1e9, 3),
     }
+
+
+# the per-strategy table measures these profiles (name -> jax profile)
+EC_PROFILES = {
+    "rs84": {"plugin": "jax", "k": "8", "m": "4"},
+    "cauchy42": {"plugin": "jax", "k": "4", "m": "2",
+                 "technique": "cauchy_good"},
+}
+
+
+def _ec_time(fn, reps: int = 1) -> float:
+    """Warm (compile) + time `reps` steady-state calls."""
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_ec_jax() -> dict:
+    """Device-engine EC stage: per-profile encode/decode GB/s for EVERY
+    strategy (ec.jax_backend.STRATEGIES), the measured autotune pick,
+    the XOR-schedule lowering stats, batched-stripe rates, and the jit
+    cache-counter deltas proving 0 compiles across stripes AND across
+    warmed erasure patterns.
+
+    Stripes are DEVICE-RESIDENT across calls (HBM is the TPU's RAM
+    exactly as the reference benchmark's buffers live in host RAM);
+    completion is forced by fetching a tiny result slice, so the rate
+    measures encode work, not tunnel I/O.  The per-strategy table runs
+    at quarter size (the headline keys run full EC_MB); cpu runs time
+    the pallas strategy on a one-tile sample — interpret mode executes
+    the kernel per grid step in python and would swamp the stage."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.jax_backend import STRATEGIES, pallas_interpret
+    from ceph_tpu.ec.registry import create_erasure_code
+    from ceph_tpu.ec.xor_schedule import build_schedule
+
+    rng = np.random.default_rng(1)
+    out: dict = {"ec_mb": EC_MB, "profiles": {}}
+    # the table covers the authoritative strategy list; a forced env
+    # strategy (a true override: engines ignore per-call picks under
+    # it) narrows the table to itself
+    forced = os.environ.get("CEPH_TPU_EC_STRATEGY")
+    table = (forced,) if forced else tuple(
+        s for s in STRATEGIES if s != "auto"
+    )
+
+    def dev_stripe(k, L):
+        return jax.device_put(jnp.asarray(
+            rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+        ))
+
+    interp = pallas_interpret()
+    for pname, prof in EC_PROFILES.items():
+        k, mm = int(prof["k"]), int(prof["m"])
+        n = k + mm
+        Lq = max(4096, (EC_MB * (1 << 20) // k) // 4)
+        ddata = dev_stripe(k, Lq)
+        rec: dict = {}
+        for strategy in table:
+            p = dict(prof)
+            p["strategy"] = strategy
+            code = create_erasure_code(p)
+            d = ddata
+            note = None
+            if strategy == "pallas" and interp:
+                d = ddata[:, :4096]
+                note = "interpret-sample"
+            total = int(np.prod(d.shape))
+
+            def enc():
+                r = code.encode_chunks(d)
+                np.asarray(r[-1, :64])  # force completion
+
+            enc_s = _ec_time(enc)
+            encoded = code.encode_chunks(d)
+            chunks = {i: encoded[i] for i in range(n)
+                      if i not in (0, 5)}
+            Ld = int(d.shape[1])
+
+            def dec():
+                r = code.decode_chunks({0, 5}, dict(chunks), Ld)
+                np.asarray(r[0][:64])
+
+            dec_s = _ec_time(dec)
+            srec = {
+                "encode_gbps": round(total / enc_s / 1e9, 3),
+                "decode2_gbps": round(total / dec_s / 1e9, 3),
+            }
+            if note:
+                srec["note"] = note
+            rec[strategy] = srec
+        sched = build_schedule(
+            create_erasure_code(dict(prof)).C
+        )
+        rec["xor_schedule"] = sched.stats()
+        out["profiles"][pname] = rec
+
+    # headline: autotuned full-size RS(8,4) + the trace-once proof
+    k, mm = 8, 4
+    n = k + mm
+    L = EC_MB * (1 << 20) // k
+    total = k * L
+    code = create_erasure_code(
+        {"plugin": "jax", "k": "8", "m": "4", "strategy": "auto"}
+    )
+    ddata = dev_stripe(k, L)
+
+    def enc():
+        r = code.encode_chunks(ddata)
+        np.asarray(r[-1, :64])
+
+    enc_s = _ec_time(enc, reps=REPS)
+    tunes = list(code.engine.autotune.values())
+    if tunes:  # one record: the RS(8,4) generator
+        out["autotune"] = tunes[-1]
+    out["strategy"] = code.engine._resolved_strategy
+    encoded = code.encode_chunks(ddata)
+    patterns = ((0, 5), (1, 2))  # two erasure patterns, both warmed
+    chunk_sets = [
+        {i: encoded[i] for i in range(n) if i not in pat}
+        for pat in patterns
+    ]
+
+    def dec(j):
+        pat, chunks = patterns[j], chunk_sets[j]
+        r = code.decode_chunks(set(pat), dict(chunks), L)
+        np.asarray(r[pat[0]][:64])
+
+    dec_s = _ec_time(lambda: dec(0), reps=REPS)
+    dec(1)  # warm the second pattern's plan + executable
+
+    # reference-faithful parity rate: the reference benchmark's encoded
+    # data chunks alias the input bufferlist (zero copy), so parity
+    # generation is the measured work; encode_chunks additionally pays
+    # a full-stripe device copy (see rs84_encode_gbps_jax)
+    def par():
+        r = code.encode_parity(ddata)
+        np.asarray(r[-1, :64])
+
+    par_s = _ec_time(par, reps=REPS)
+    out["rs84_parity_gbps_jax"] = round(total / par_s / 1e9, 3)
+
+    # same-machine r05 baseline: the exact strategy r05's jax number
+    # (0.153 GB/s) ran — calibrates this container against the r05 CPU
+    # class, so vs_r05_strategy is the hardware-normalized speedup
+    code_r05 = create_erasure_code(
+        {"plugin": "jax", "k": "8", "m": "4", "strategy": "logexp"}
+    )
+
+    def enc_r05():
+        r = code_r05.encode_chunks(ddata)
+        np.asarray(r[-1, :64])
+
+    r05_s = _ec_time(enc_r05)
+    out["r05_strategy_gbps"] = round(total / r05_s / 1e9, 3)
+
+    # trace-once proof: fresh stripes and BOTH patterns, zero compiles
+    jit0 = _jit_counters()
+    for _ in range(2):
+        enc()
+        dec(0)
+        dec(1)
+    warm_delta = _jit_delta(jit0)
+    out["jit_after_warmup"] = warm_delta
+    out["trace_once_ok"] = warm_delta.get("compiles", 0) == 0
+
+    # batched stripes: 4 stripes in one dispatch
+    nb = 4
+    batch = jnp.stack(
+        [dev_stripe(k, max(4096, L // nb)) for _ in range(nb)]
+    )
+    bbytes = int(np.prod(batch.shape))
+
+    def encb():
+        r = code.encode_batch(batch)
+        np.asarray(r[-1, -1, :64])
+
+    encb_s = _ec_time(encb, reps=REPS)
+    out["batch"] = {
+        "stripes": nb,
+        "encode_gbps": round(bbytes / encb_s / 1e9, 3),
+    }
+    out["rs84_encode_gbps_jax"] = round(total / enc_s / 1e9, 3)
+    out["rs84_decode2_gbps_jax"] = round(total / dec_s / 1e9, 3)
+    if out["r05_strategy_gbps"] > 0:
+        out["vs_r05_strategy"] = round(
+            out["rs84_encode_gbps_jax"] / out["r05_strategy_gbps"], 1
+        )
+    return out
 
 
 def bench_clay() -> dict:
@@ -558,9 +732,8 @@ def worker() -> None:
 
     if not os.environ.get("BENCH_SKIP_EC"):
         # EC outranks mapping: a mapping failure can't destroy EC numbers
-        sched.add("ec_jax",
-                  ec_stage("jax", {"plugin": "jax", "k": "8", "m": "4"}),
-                  priority=90, est_s=25, min_budget_s=20)
+        sched.add("ec_jax", lambda h: bench_ec_jax(),
+                  priority=90, est_s=40, min_budget_s=25)
         sched.add("ec_native",
                   ec_stage("native", {"plugin": "isa", "k": "8", "m": "4",
                                       "backend": "native"}),

@@ -1,9 +1,12 @@
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
 from ceph_tpu.ec.registry import create_erasure_code, list_plugins
+from ceph_tpu.ec.xor_schedule import XorSchedule, build_schedule
 
 __all__ = [
     "ErasureCode",
     "ErasureCodeProfileError",
+    "XorSchedule",
+    "build_schedule",
     "create_erasure_code",
     "list_plugins",
 ]

@@ -31,7 +31,7 @@ import numpy as np
 
 from ceph_tpu import obs
 from ceph_tpu.ec import matrices
-from ceph_tpu.ec.gf import gf_matvec_data
+from ceph_tpu.ec.gf import GF_MUL_TABLE, gf_matvec_data
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
 
 _L = obs.logger_for("ec")
@@ -43,6 +43,10 @@ _L.add_u64("repair_bytes", "chunk bytes rebuilt by minimum-bandwidth repair")
 _L.add_time_avg("repair_seconds", "repair wall time")
 _L.add_avg("repair_read_fraction",
            "helper bytes read / full-stripe bytes, per repair")
+_L.add_u64("repair_plan_hits",
+           "batched repairs served by a cached product-matrix plan")
+_L.add_u64("repair_plan_misses",
+           "product-matrix repair plans built (one per lost node)")
 
 
 def _pow_int(a: int, x: int) -> int:
@@ -77,6 +81,8 @@ class ClayCode(ErasureCode):
         self.t = 0
         self.nu = 0
         self.sub_chunk_no = 0
+        # lost node -> product-matrix repair plan (see _repair_plan)
+        self._repair_plans: dict[int, dict] = {}
 
     # -- profile -----------------------------------------------------------
     def parse(self, profile: dict) -> None:
@@ -113,7 +119,10 @@ class ClayCode(ErasureCode):
         self.pft = _PairTransform()
         from ceph_tpu.ec.rs import get_engine
 
-        self.engine = get_engine(profile.get("backend", "numpy"))
+        self.engine = get_engine(
+            profile.get("backend", "numpy"), profile.get("strategy")
+        )
+        self._repair_plans.clear()  # geometry may have changed
 
     def get_sub_chunk_count(self) -> int:
         return self.sub_chunk_no
@@ -585,10 +594,11 @@ class ClayCode(ErasureCode):
             [self._z_vec(z) for z in repair_planes], np.int64
         )  # [P, t]
         n = q * t
-        erasures = {y_lost * q + x for x in range(q)}
         U = np.zeros((n, P, sc), np.uint8)
 
         # phase 1: uncoupled symbols of live nodes, batched per (x, y)
+        # (the lost column's nodes are the erasures; the y == y_lost
+        # guard below skips them, and _repair_plan re-derives the set)
         for y in range(t):
             if y == y_lost:
                 continue  # whole lost column is erased; no live nodes here
@@ -638,43 +648,82 @@ class ClayCode(ErasureCode):
                     ).reshape(-1, sc)
                     U[node_xy][sel] = rec
 
-        # phase 2: inner MDS across every plane at once
+        # phases 2+3 fused: ONE matmul with the cached product matrix.
+        # The plan's RB row for node nd composes the inner-MDS recovery
+        # (U[nd] = R_mds[nd]·U[present]) with the pair uncoupling
+        # (rec = ch·helpers[nd] ⊕ cu·U[nd]) into direct coefficients
+        # over [helpers[col]; U[present]] — the product-matrix form of
+        # "Fast Product-Matrix Regenerating Codes" (PAPERS.md): the two
+        # chained GF matmuls per erased column node become one
+        # precomputed row, so the repair never materializes U[missing].
+        plan = self._repair_plan(lost, repair_planes)
+        recovered = np.zeros((self.sub_chunk_no, sc), np.uint8)
+        helper_rows = [
+            helpers[nd].reshape(1, -1) for nd in plan["col_others"]
+        ]
+        X = np.concatenate(
+            helper_rows
+            + [U[plan["present"]].reshape(len(plan["present"]), -1)]
+        )
+        out = np.asarray(
+            self.engine.matmul(plan["RB"], X)
+        ).reshape(len(plan["missing"]), P, sc)
+        for ri, dest in enumerate(plan["z_dest"]):
+            recovered[dest] = out[ri]
+        return recovered
+
+    def _repair_plan(self, lost: int, repair_planes: list[int]) -> dict:
+        """Cached product-matrix plan for the no-aloof batched repair of
+        `lost`: input rows are [helpers of the lost column's other
+        nodes; uncoupled rows of the surviving nodes], output row ri
+        rebuilds the coupled bytes scattered to plane set z_dest[ri]."""
+        plan = self._repair_plans.get(lost)
+        if plan is not None:
+            _L.inc("repair_plan_hits")
+            return plan
+        q, t = self.q, self.t
+        n = q * t
+        x_lost, y_lost = lost % q, lost // q
+        erasures = {y_lost * q + x for x in range(q)}
         present = sorted(set(range(n)) - erasures)[: self.k + self.nu]
         missing = sorted(erasures)
-        R = matrices.recover_matrix(self.mds_C, present, missing)
-        stack = U[present].reshape(len(present), -1)
-        out = self.engine.matmul(R, stack).reshape(len(missing), P, sc)
-        U[missing] = np.asarray(out)
-
-        # phase 3: coupled symbols of the lost column
-        recovered = np.zeros((self.sub_chunk_no, sc), np.uint8)
-        for nd in missing:
+        col_others = [nd for nd in missing if nd != lost]
+        R_mds = matrices.recover_matrix(self.mds_C, present, missing)
+        RB = np.zeros(
+            (len(missing), len(col_others) + len(present)), np.uint8
+        )
+        z_dest: list[np.ndarray] = []
+        for ri, nd in enumerate(missing):
             x = nd % q
             if x == x_lost:
-                # hole-dot planes: uncoupled == coupled
-                recovered[np.asarray(repair_planes)] = U[nd]
+                # hole-dot planes: uncoupled == coupled; row is the MDS
+                # recovery itself, landing on the repair planes
+                RB[ri, len(col_others):] = R_mds[ri]
+                z_dest.append(np.asarray(repair_planes))
                 continue
-            # partner is the lost node; writes land on its z_sw planes
-            z_sw = np.array(
+            c_xy, c_sw, u_xy, u_sw = self._pair_indices(x, x_lost)
+            known_pos = sorted((c_xy, u_xy))
+            R2 = matrices.recover_matrix(self.pft.C, known_pos, [c_sw])
+            ch = int(R2[0, known_pos.index(c_xy)])
+            cu = int(R2[0, known_pos.index(u_xy)])
+            RB[ri, col_others.index(nd)] = ch
+            RB[ri, len(col_others):] = GF_MUL_TABLE[cu, R_mds[ri]]
+            z_dest.append(np.array(
                 [
                     z + (x - x_lost) * _pow_int(q, t - 1 - y_lost)
                     for z in repair_planes
                 ]
-            )
-            c_xy, c_sw, u_xy, u_sw = self._pair_indices(x, x_lost)
-            known_pos = sorted((c_xy, u_xy))
-            R = matrices.recover_matrix(self.pft.C, known_pos, [c_sw])
-            stack = np.stack(
-                [
-                    helpers[nd] if p == c_xy else U[nd]
-                    for p in known_pos
-                ]
-            )
-            rec = self.engine.matmul(
-                R, stack.reshape(2, -1)
-            ).reshape(-1, sc)
-            recovered[z_sw] = rec
-        return recovered
+            ))
+        plan = {
+            "present": present,
+            "missing": missing,
+            "col_others": col_others,
+            "RB": RB,
+            "z_dest": z_dest,
+        }
+        self._repair_plans[lost] = plan
+        _L.inc("repair_plan_misses")
+        return plan
 
     def decode(
         self,

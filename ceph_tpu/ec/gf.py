@@ -55,6 +55,16 @@ def gf_mul(a, b):
     return GF_MUL_TABLE[a, b]
 
 
+def gf_xtime(x: np.ndarray) -> np.ndarray:
+    """Element-wise doubling (·2) in GF(2^8)/0x11D, branch-free:
+    (x<<1) ^ (0x1D masked by bit 7 via arithmetic shift).  The host
+    twin of the device executor's `_xtime` (ec.jax_backend) — the XOR
+    schedules' only non-XOR primitive."""
+    x = np.asarray(x, np.uint8)
+    mask = ((x.astype(np.int8) >> 7).astype(np.uint8)) & np.uint8(0x1D)
+    return ((x << 1).astype(np.uint8)) ^ mask
+
+
 def gf_inv(a):
     a = int(a)
     if a == 0:

@@ -1,25 +1,38 @@
-"""TPU engine for GF(2^8) chunk math.
+"""Device engine for GF(2^8) chunk math — strategies, caches, batching.
 
-Two compiled strategies for parity = C·data over GF(2^8):
+STRATEGIES below is the one authoritative list (names, mechanism, when
+each wins).  Every strategy is bit-exact against the host mul-table
+oracle and the frozen ec_corpus; they differ only in how the matmul
+parity = M·data lowers onto the device:
 
-1. **Bit-plane MXU matmul** (default on TPU): expand C to its (8m × 8k)
-   GF(2) bit-matrix (any GF(2^8) constant multiply is GF(2)-linear on the
-   byte's bits — the same fact behind jerasure's bitmatrix schedules),
-   unpack data bytes to bit rows, and compute parity bits as an int8 matmul
-   mod 2 on the MXU, then repack.  This turns erasure coding into dense
-   matrix multiply — the op the TPU is built for — instead of the reference's
-   table-lookup SIMD loops (isa-l ec_encode_data, reference
-   src/erasure-code/isa/ErasureCodeIsa.cc:120-149).
+- compiled *executables* cache in the module-level `_EC_CACHE` keyed on
+  structural facts only (matrix content / shape, stripe shape, batch
+  arity) — the same trace-once contract as pipeline_jax._PIPE_CACHE,
+  booked into the shared `pipe_cache_hits`/`pipe_cache_misses`
+  counters.  One compile per (profile matrix or decode-plan matrix,
+  stripe shape); every further stripe and every repeat of an erasure
+  pattern is a dispatch.
+- XOR schedules (ec.xor_schedule) lower once per matrix at
+  profile-registration time (`JaxEngine.prepare`).
+- `encode_batch`-style multi-stripe calls ride `matmul_batch`, which
+  vmaps the single-stripe kernels over a leading stripes axis with the
+  GF tables as operands.
 
-2. **log/antilog VPU path**: parity bytes via exp[log C + log data] gathers,
-   XOR-reduced over k.  Fewer memory blowups; wins for tiny stripes.
+The `tile` knob (default `_BIT_TILE`) bounds the bitplane strategy's
+8× bit expansion: byte axes longer than `tile` are processed in
+`lax.map` tiles so peak memory is O(tile).  The pallas strategy has its
+own VMEM tile (`_PALLAS_TILE`).
 
-The byte axis is tiled with lax.map so the 8× bit expansion never
-materializes for more than one tile.
+Strategy selection: `CEPH_TPU_EC_STRATEGY` env var > explicit
+constructor arg > backend default (cpu: `xor`, accelerators: `pallas`).
+`strategy="auto"` runs a small measured autotune per matrix (cached in
+`_AUTOTUNE`, recorded in BENCH's ec stage).
 """
 
 from __future__ import annotations
 
+import os
+import time
 from functools import partial
 
 import jax
@@ -28,19 +41,67 @@ import numpy as np
 
 from ceph_tpu import obs
 from ceph_tpu.ec.gf import GF_LOG, gf_device_tables, matrix_to_bitmatrix
+from ceph_tpu.ec.xor_schedule import XorSchedule, build_schedule, matrix_key
 
-_BIT_TILE = 1 << 17  # bytes per lane-tile in the bitplane path
+# byte-axis tile of the bitplane strategy (see module docstring)
+_BIT_TILE = 1 << 17
+# VMEM byte-axis tile of the pallas strategy
+_PALLAS_TILE = 1 << 12
+
+#: name -> how it computes parity = M·data, and when it wins.  This dict
+#: is the single source of truth for strategy names; the engine and the
+#: CEPH_TPU_EC_STRATEGY env override validate against it.
+STRATEGIES = {
+    "xor": (
+        "XOR schedule over virtual byte rows 2^j·data[i] (naive term "
+        "form: XLA fuses the whole program into one pass; recompute is "
+        "free inside a fusion).  Fastest on CPU."
+    ),
+    "xor_cse": (
+        "Same schedule, CSE form: temps materialized per Paar dedup. "
+        "Fewer XORs on paper; wins only where temps beat recompute."
+    ),
+    "bitplane": (
+        "GF(2) bit-matrix as int8 MXU matmul mod 2; byte axis tiled to "
+        "`tile` (default _BIT_TILE).  The dense-matmul form for MXU-class "
+        "hardware via plain XLA."
+    ),
+    "logexp": (
+        "exp[log M + log data] gathers XOR-reduced over k; matrix baked "
+        "into the trace (retraces per matrix), tables are operands."
+    ),
+    "pallas": (
+        "Fused Pallas kernel: VMEM-tiled unpack -> MXU matmul -> repack "
+        "(tile _PALLAS_TILE).  Interpret-mode when the runtime ladder's "
+        "provenance says the backend is cpu; real lowering otherwise."
+    ),
+    "auto": (
+        "Measured autotune over the backend's candidate strategies on a "
+        "small sample, cached per matrix in _AUTOTUNE."
+    ),
+}
 
 _L = obs.logger_for("ec")
+# _EC_CACHE books into the same aggregate the pipeline cache uses
+# (obs.jit_counters special-cases these names): the bench `jit` records
+# prove EC dispatches ride cached executables exactly like pipelines.
+_L.add_u64("pipe_cache_hits",
+           "EC executables served from _EC_CACHE (no new jit)")
+_L.add_u64("pipe_cache_misses", "EC executables built into _EC_CACHE")
+_L.add_u64("autotunes", "measured strategy autotunes (one per matrix)")
 
 
 def _matmul_key(eng, M, data) -> tuple:
     """Warm-key granularity mirrors the actual jit caches: bitplane /
     pallas trace on array shapes only (the bitmatrix is a traced
-    operand), while logexp passes the matrix as a static tuple and
-    recompiles per content."""
-    mat_key = eng._key(M) if eng.strategy == "logexp" else M.shape
-    return (mat_key, np.shape(data), eng.strategy)
+    operand), while logexp and the xor schedules trace per matrix
+    content."""
+    strategy = eng._resolved_strategy
+    if strategy in ("logexp", "xor", "xor_cse"):
+        mat_key = eng._key(M)
+    else:
+        mat_key = M.shape
+    return (mat_key, np.shape(data), strategy)
 
 
 # Module-level (one shared warm set) because the jit caches it models
@@ -53,7 +114,19 @@ _gf_acct = obs.JitAccount(
     span_args=lambda eng, M, data: {
         "rows": int(M.shape[0]),
         "bytes": int(np.prod(np.shape(data))),
-        "strategy": eng.strategy,
+        "strategy": eng._resolved_strategy,
+    },
+)
+
+_gf_batch_acct = obs.JitAccount(
+    lambda eng, M, data: eng._matmul_batch(M, data), _L, "gf_batch",
+    key_fn=_matmul_key,
+    span="ec.gf_matmul_batch",
+    span_args=lambda eng, M, data: {
+        "rows": int(M.shape[0]),
+        "stripes": int(np.shape(data)[0]),
+        "bytes": int(np.prod(np.shape(data))),
+        "strategy": eng._resolved_strategy,
     },
 )
 
@@ -95,7 +168,46 @@ def _matmul_logexp(M_tuple, data, exp, log):
     return jnp.stack(rows)
 
 
-def gf_matmul_pallas(Bbits, data, n_out: int, tile: int = 4096):
+def _xtime(x):
+    """Traced GF(2^8)/0x11D doubling, branch-free: the arithmetic-shift
+    mask form ((int8 >> 7) & 0x1D) measures ~3x faster than the
+    jnp.where select on XLA CPU (PROFILE_r07)."""
+    mask = (x.astype(jnp.int8) >> 7).astype(jnp.uint8) & jnp.uint8(0x1D)
+    return jnp.left_shift(x, 1).astype(jnp.uint8) ^ mask
+
+
+def xor_schedule_fn(sched: XorSchedule, use_cse: bool):
+    """Traceable executor of an XOR schedule: data u8[S, L] -> u8[R, L].
+
+    The program is unrolled from the schedule, so the trace (and the
+    compiled executable) is structural per (matrix, cse-form) — exactly
+    what `_EC_CACHE` keys on."""
+    m, k = sched.shape
+
+    def fn(data):
+        vals = {}
+        for i in range(k):
+            v = data[i]
+            vals[8 * i] = v
+            for j in range(1, sched.max_power[i] + 1):
+                v = _xtime(v)
+                vals[8 * i + j] = v
+        if use_cse:
+            for tid, a, b in sched.ops:
+                vals[tid] = vals[a] ^ vals[b]
+        outs = []
+        for term in (sched.outs if use_cse else sched.terms):
+            acc = None
+            for t in term:
+                acc = vals[t] if acc is None else acc ^ vals[t]
+            outs.append(acc if acc is not None else jnp.zeros_like(data[0]))
+        return jnp.stack(outs)
+
+    return fn
+
+
+def gf_matmul_pallas(Bbits, data, n_out: int, tile: int = 4096,
+                     interpret: bool | None = None):
     """Fused Pallas TPU kernel: parity = (GF(2) bit-matrix) · data.
 
     The pure-XLA bitplane path materializes the 8× bit expansion in HBM
@@ -104,6 +216,11 @@ def gf_matmul_pallas(Bbits, data, n_out: int, tile: int = 4096):
     mod-2 repack entirely in VMEM, so HBM traffic is exactly data-in +
     parity-out.  bf16 is exact here: bit operands are 0/1 and the MXU
     accumulates bf16 products in f32 (sums <= 8S << 2^24).
+
+    `interpret=None` gates on the runtime ladder's backend provenance
+    (ceph_tpu.runtime.last_provenance): runs that degraded to cpu get
+    interpret mode (CI runs the same kernel code), acquisitions that
+    landed on an accelerator get the real Mosaic lowering.
 
     Matches the role of isa-l's ec_encode_data SIMD loops (reference
     src/erasure-code/isa/ErasureCodeIsa.cc:120-149) as the engine's
@@ -114,6 +231,8 @@ def gf_matmul_pallas(Bbits, data, n_out: int, tile: int = 4096):
     S, L = data.shape
     R8 = Bbits.shape[0]
     assert L % tile == 0, (L, tile)
+    if interpret is None:
+        interpret = pallas_interpret()
 
     def kernel(b_ref, d_ref, o_ref):
         d = d_ref[...]  # u8 [S, tile]
@@ -139,39 +258,86 @@ def gf_matmul_pallas(Bbits, data, n_out: int, tile: int = 4096):
         ],
         out_specs=pl.BlockSpec((n_out, tile), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((n_out, L), jnp.uint8),
-        interpret=jax.default_backend() == "cpu",  # CI runs the same kernel
+        interpret=interpret,
     )(Bbits, data)
+
+
+def pallas_interpret() -> bool:
+    """True when the Pallas kernels should run in interpret mode: the
+    runtime ladder's acquisition provenance (authoritative — it is what
+    actually probed the hardware) says cpu, or, before any acquisition,
+    jax's default backend is cpu."""
+    from ceph_tpu import runtime
+
+    prov = runtime.last_provenance()
+    backend = (prov or {}).get("backend") or jax.default_backend()
+    return backend in ("cpu", "none")
+
+
+# -- trace-once executable cache (the _PIPE_CACHE contract) -----------------
+# key -> jitted callable.  Keys are structural only: (kind, matrix key or
+# shape, cse-form, batched).  jax.jit adds its own per-input-shape cache
+# under each entry, so one entry serves every stripe length.
+_EC_CACHE: dict[tuple, object] = {}
+
+
+def _ec_cached(key: tuple, build):
+    fn = _EC_CACHE.get(key)
+    if fn is None:
+        _L.inc("pipe_cache_misses")
+        fn = build()
+        _EC_CACHE[key] = fn
+    else:
+        _L.inc("pipe_cache_hits")
+    return fn
+
+
+# measured autotune results: (backend, matrix key) -> record dict
+_AUTOTUNE: dict[tuple, dict] = {}
 
 
 class JaxEngine:
     """Device GF matmul engine: M u8[R,S] × data u8[S,L] -> u8[R,L].
 
-    Device constants (the GF(2) bit-matrix of M) are cached per matrix —
-    the engine is reused across calls with the same code matrix (encode,
-    repeated decode) without re-deriving or re-uploading anything.  When
-    `data` is already a jax array the result STAYS on device (no host
-    round-trip); numpy in → numpy out for the host-facing plugin API.
+    Device constants (bit-matrices, XOR schedules) are cached per matrix
+    in process-global caches — the engine is reused across calls with
+    the same code matrix (encode, repeated decode) without re-deriving,
+    re-tracing, or re-uploading anything.  When `data` is already a jax
+    array the result STAYS on device (no host round-trip); numpy in →
+    numpy out for the host-facing plugin API, with the d2h fetch booked
+    into `gf_fetch_seconds` (outside the dispatch span — the
+    check_no_host_sync lint covers `ec.gf_dispatch`).
+
+    Strategy resolution (see STRATEGIES): env CEPH_TPU_EC_STRATEGY (a
+    true override — it FORCES the strategy even when a profile or
+    caller picked one) > explicit arg / profile["strategy"] > backend
+    default (cpu: xor, else pallas).
     """
 
     def __init__(self, strategy: str | None = None, tile: int = _BIT_TILE):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
+        env = os.environ.get("CEPH_TPU_EC_STRATEGY")
+        if env:
+            strategy = env
         if strategy is None:
-            strategy = (
-                "pallas"
-                if jax.default_backend() not in ("cpu",)
-                else "logexp"
+            strategy = "xor" if jax.default_backend() == "cpu" else "pallas"
+        if strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown EC strategy {strategy!r}; "
+                f"pick one of {sorted(STRATEGIES)}"
             )
-        assert strategy in ("pallas", "bitplane", "logexp")
         self.strategy = strategy
         self.tile = tile
         self._bitmats: dict[tuple, jnp.ndarray] = {}
         self._logexp_cache: dict[tuple, tuple] = {}
+        self._resolved_strategy = strategy  # per-call for "auto"
+        self.autotune: dict[tuple, dict] = {}  # matrix key -> record
 
     @staticmethod
     def _key(M: np.ndarray):
-        return (M.shape, M.tobytes())
+        return matrix_key(M)
 
     def _bitmat(self, M: np.ndarray):
         key = self._key(M)
@@ -181,45 +347,164 @@ class JaxEngine:
             self._bitmats[key] = B
         return B
 
+    def prepare(self, M: np.ndarray) -> None:
+        """Profile-registration hook: derive the matrix's structural
+        artifacts (XOR schedule, bit-matrix, logexp tuple) ONCE, before
+        any stripe arrives.  Called by the plugins at parse() time so
+        the first encode pays only the jit compile, and by the decode
+        plan cache for each new erasure pattern's recover matrix."""
+        M = np.asarray(M, np.uint8)
+        s = self.strategy
+        if s in ("xor", "xor_cse", "auto"):
+            build_schedule(M)
+        if s in ("bitplane", "pallas", "auto"):
+            self._bitmat(M)
+        if s in ("logexp", "auto"):
+            self._logexp_tuple(M)
+
+    def _logexp_tuple(self, M: np.ndarray):
+        key = self._key(M)
+        mt = self._logexp_cache.get(key)
+        if mt is None:
+            mt = tuple(tuple(int(c) for c in r) for r in M)
+            self._logexp_cache[key] = mt
+        return mt
+
+    # -- strategy resolution / autotune ---------------------------------
+    def _candidates(self) -> tuple[str, ...]:
+        if jax.default_backend() == "cpu":
+            # pallas-interpret is orders of magnitude off; not a candidate
+            return ("xor", "xor_cse", "bitplane", "logexp")
+        return ("pallas", "bitplane", "xor", "logexp")
+
+    def _resolve(self, M: np.ndarray, d) -> str:
+        """Concrete strategy for this matrix (autotunes on 'auto')."""
+        if self.strategy != "auto":
+            return self.strategy
+        key = (jax.default_backend(), self._key(M))
+        rec = _AUTOTUNE.get(key)
+        if rec is None:
+            rec = self._run_autotune(M, d)
+            _AUTOTUNE[key] = rec
+        self.autotune[key[1]] = rec
+        return rec["strategy"]
+
+    def _run_autotune(self, M: np.ndarray, d) -> dict:
+        """Measure each candidate on a small sample slice and pick the
+        fastest.  Runs OUTSIDE the dispatch span (it blocks on results);
+        one-time per (backend, matrix), cached in _AUTOTUNE."""
+        sample_L = min(d.shape[1], 1 << 16)
+        sample = jnp.asarray(d[:, :sample_L])
+        measured: dict[str, float] = {}
+        errors: dict[str, str] = {}
+        nbytes = int(np.prod(sample.shape))
+        for s in self._candidates():
+            try:
+                run = lambda: jax.block_until_ready(
+                    self._dispatch(s, M, sample)
+                )
+                run()  # compile + warm
+                t0 = time.perf_counter()
+                run()
+                dt = time.perf_counter() - t0
+                measured[s] = round(nbytes / max(dt, 1e-9) / 1e9, 3)
+            except Exception as e:  # one strategy down ≠ engine down,
+                # but the failure must stay visible in the record (the
+                # pallas lowering on fresh hardware is the expected case)
+                measured[s] = 0.0
+                errors[s] = f"{type(e).__name__}: {e}"[:200]
+        working = {s: g for s, g in measured.items() if g > 0}
+        if not working:
+            raise RuntimeError(
+                f"EC autotune: every candidate strategy failed: {errors}"
+            )
+        best = max(working, key=lambda s: working[s])
+        _L.inc("autotunes")
+        rec = {"strategy": best, "measured_gbps": measured,
+               "sample_bytes": nbytes}
+        if errors:
+            rec["errors"] = errors
+        return rec
+
+    # -- entry points ----------------------------------------------------
     def matmul(self, M: np.ndarray, data):
         """Instrumented entry point: spans + compile/dispatch split.  A
         (matrix, shape, strategy) triple not seen by this process before
         pays the jit trace+compile; its wall time books into
         ec.gf_compile_seconds, steady-state calls into
-        ec.gf_dispatch_seconds (dispatch only — device completion is the
-        caller's fetch)."""
+        ec.gf_dispatch_seconds (dispatch only — the host-facing fetch is
+        booked separately into ec.gf_fetch_seconds)."""
         M = np.asarray(M, np.uint8)
-        return _gf_acct(self, M, data)
-
-    def _matmul(self, M: np.ndarray, data):
         on_device = isinstance(data, jax.Array)
-        d = data if on_device else jnp.asarray(data, jnp.uint8)
+        d = data if on_device else jnp.asarray(
+            np.asarray(data, np.uint8)
+        )
+        self._resolved_strategy = self._resolve(M, d)
+        out = _gf_acct(self, M, d)
+        if on_device:
+            return out
+        return obs.timed_fetch(_L, "gf", out)
+
+    def matmul_batch(self, M: np.ndarray, data):
+        """Batched-stripe matmul: data [N, S, L] -> [N, R, L], one
+        dispatch for the whole stripe batch (vmapped over the stripes
+        axis; tables/bitmatrices ride as operands, so stripe count N is
+        just another shape — no per-stripe retrace)."""
+        M = np.asarray(M, np.uint8)
+        on_device = isinstance(data, jax.Array)
+        d = data if on_device else jnp.asarray(
+            np.asarray(data, np.uint8)
+        )
+        assert d.ndim == 3, d.shape
+        self._resolved_strategy = self._resolve(M, d[0])
+        out = _gf_batch_acct(self, M, d)
+        if on_device:
+            return out
+        return obs.timed_fetch(_L, "gf_batch", out)
+
+    # -- dispatch (device work only; no host syncs in here) --------------
+    def _matmul(self, M: np.ndarray, d):
+        with obs.span(
+            "ec.gf_dispatch", rows=int(M.shape[0]),
+            strategy=self._resolved_strategy,
+        ):
+            return self._dispatch(self._resolved_strategy, M, d)
+
+    def _matmul_batch(self, M: np.ndarray, d):
+        with obs.span(
+            "ec.gf_dispatch", rows=int(M.shape[0]), batched=True,
+            strategy=self._resolved_strategy,
+        ):
+            return self._dispatch_batch(self._resolved_strategy, M, d)
+
+    def _dispatch(self, strategy: str, M: np.ndarray, d):
         S, L = d.shape
-
-        def finish(out):
-            return out if on_device else np.asarray(out)
-
-        if self.strategy == "logexp":
-            key = self._key(M)
-            mt = self._logexp_cache.get(key)
-            if mt is None:
-                mt = tuple(tuple(int(c) for c in r) for r in M)
-                self._logexp_cache[key] = mt
+        if strategy == "logexp":
             gft = gf_device_tables()
-            return finish(_matmul_logexp(mt, d, gft["exp"], gft["log"]))
+            return _matmul_logexp(self._logexp_tuple(M), d,
+                                  gft["exp"], gft["log"])
+        if strategy in ("xor", "xor_cse"):
+            sched = build_schedule(M)
+            use_cse = strategy == "xor_cse"
+            fn = _ec_cached(
+                ("xor", sched.key, use_cse, False),
+                lambda: jax.jit(xor_schedule_fn(sched, use_cse)),
+            )
+            return fn(d)
         B = self._bitmat(M)
         R = M.shape[0]
-        if self.strategy == "pallas":
-            ptile = 1 << 12
+        if strategy == "pallas":
+            ptile = _PALLAS_TILE
             if L % ptile == 0 and L >= ptile:
-                return finish(gf_matmul_pallas(B, d, R, tile=ptile))
+                return gf_matmul_pallas(B, d, R, tile=ptile)
             # ragged tail: pad to a tile multiple (pads are zeros; GF
             # linearity makes padded parity columns zeros too)
             Lp = -(-L // ptile) * ptile
             dpad = jnp.pad(d, ((0, 0), (0, Lp - L)))
-            return finish(gf_matmul_pallas(B, dpad, R, tile=ptile)[:, :L])
+            return gf_matmul_pallas(B, dpad, R, tile=ptile)[:, :L]
+        # bitplane
         if L <= self.tile:
-            return finish(_matmul_bitplane(B, d, R))
+            return _matmul_bitplane(B, d, R)
         # tile the byte axis; pad L up to a tile multiple
         T = (L + self.tile - 1) // self.tile
         pad = T * self.tile - L
@@ -229,4 +514,56 @@ class JaxEngine:
             lambda t: _matmul_bitplane(B, t, R), tiles
         )  # [T, R, tile]
         out = out.transpose(1, 0, 2).reshape(R, T * self.tile)
-        return finish(out[:, :L])
+        return out[:, :L]
+
+    def _dispatch_batch(self, strategy: str, M: np.ndarray, d):
+        N, S, L = d.shape
+        R = M.shape[0]
+        if strategy == "pallas":
+            # per-stripe independence: the stripes axis folds into the
+            # byte axis, one kernel launch covers the whole batch
+            flat = d.transpose(1, 0, 2).reshape(S, N * L)
+            out = self._dispatch(strategy, M, flat)
+            return out.reshape(R, N, L).transpose(1, 0, 2)
+        if strategy == "logexp":
+            gft = gf_device_tables()
+            mt = self._logexp_tuple(M)  # plain tuple: the cached
+            # executable must not close over the engine instance
+            fn = _ec_cached(
+                ("logexp", self._key(M), None, True),
+                lambda: jax.jit(jax.vmap(
+                    lambda dd, exp, log: _matmul_logexp(
+                        mt, dd, exp, log
+                    ),
+                    in_axes=(0, None, None),
+                )),
+            )
+            return fn(d, gft["exp"], gft["log"])
+        if strategy in ("xor", "xor_cse"):
+            sched = build_schedule(M)
+            use_cse = strategy == "xor_cse"
+            fn = _ec_cached(
+                ("xor", sched.key, use_cse, True),
+                lambda: jax.jit(
+                    jax.vmap(xor_schedule_fn(sched, use_cse))
+                ),
+            )
+            return fn(d)
+        # bitplane: vmap over stripes while the whole batch's bit
+        # expansion stays under the `tile` bound; beyond it, fold the
+        # stripes axis into the byte axis so the single-stripe lax.map
+        # tiling keeps peak memory O(tile) (stripes are independent, so
+        # the fold is exact)
+        if N * L > self.tile:
+            flat = d.transpose(1, 0, 2).reshape(S, N * L)
+            out = self._dispatch(strategy, M, flat)
+            return out.reshape(R, N, L).transpose(1, 0, 2)
+        B = self._bitmat(M)
+        fn = _ec_cached(
+            ("bitplane", (R, S), None, True),
+            lambda: jax.jit(
+                jax.vmap(_matmul_bitplane, in_axes=(None, 0, None)),
+                static_argnums=(2,),
+            ),
+        )
+        return fn(B, d, R)

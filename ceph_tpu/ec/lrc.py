@@ -23,7 +23,8 @@ from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
 
 
 class _Layer:
-    def __init__(self, chunks_map: str, profile: dict):
+    def __init__(self, chunks_map: str, profile: dict,
+                 parent: dict | None = None):
         self.chunks_map = chunks_map
         self.data = [i for i, ch in enumerate(chunks_map) if ch == "D"]
         self.coding = [i for i, ch in enumerate(chunks_map) if ch == "c"]
@@ -34,6 +35,12 @@ class _Layer:
         prof.setdefault("m", len(self.coding))
         prof.setdefault("plugin", "jerasure")
         prof.setdefault("technique", "reed_sol_van")
+        # the engine knobs inherit from the outer lrc profile: a
+        # backend=jax lrc runs every layer's matmuls on the device
+        # engine unless a layer profile overrides them
+        for knob in ("backend", "strategy"):
+            if parent and parent.get(knob) is not None:
+                prof.setdefault(knob, parent[knob])
         from ceph_tpu.ec.registry import create_erasure_code
 
         self.code = create_erasure_code(prof)
@@ -119,7 +126,7 @@ class LrcCode(ErasureCode):
                     lpd[key] = v
             else:
                 lpd = dict(lp)
-            self.layers.append(_Layer(cm, lpd))
+            self.layers.append(_Layer(cm, lpd, parent=profile))
         if not self.layers:
             raise ErasureCodeProfileError("lrc: at least one layer needed")
         # chunk_mapping from the global mapping: D positions then the rest

@@ -176,12 +176,25 @@ def decode_matrix(C: np.ndarray, present_rows: list[int]) -> np.ndarray:
     return gf_invert_matrix(sub)
 
 
+# recover_matrix is pure in (C, present, want) and sits on every decode
+# and Clay pair/plane hot path; before this cache each call re-ran the
+# Gauss–Jordan inversion.  Keys are tiny (code matrices), values m×k.
+_RECOVER_CACHE: dict[tuple, np.ndarray] = {}
+
+
 def recover_matrix(
     C: np.ndarray, present: list[int], want: list[int]
 ) -> np.ndarray:
     """Rows that rebuild the `want` chunks (data or parity ids) directly
-    from the first k `present` chunks: R = G[want] · inv(G[present])."""
-    k = C.shape[1]
-    inv = decode_matrix(C, present)
-    G = generator(C)
-    return gf_matmul(G[list(want)], inv)
+    from the first k `present` chunks: R = G[want] · inv(G[present]).
+    Cached per (matrix content, present, want) — the inner step of every
+    cached decode/repair plan."""
+    C = np.asarray(C, np.uint8)
+    key = (C.shape, C.tobytes(), tuple(present), tuple(want))
+    R = _RECOVER_CACHE.get(key)
+    if R is None:
+        inv = decode_matrix(C, present)
+        G = generator(C)
+        R = gf_matmul(G[list(want)], inv)
+        _RECOVER_CACHE[key] = R
+    return R.copy()

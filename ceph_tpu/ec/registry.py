@@ -15,6 +15,11 @@ Plugin name map (reference → here):
   clay / shec / lrc → layered codes (ec.clay / ec.shec / ec.lrc)
   example   → toy XOR(k, m=1) code (mirrors the test fixture
               reference src/test/erasure-code/ErasureCodeExample.h)
+
+Engine knobs shared by every matrix-code plugin: profile["backend"]
+(numpy | native | jax) picks the per-stripe math engine and, for jax,
+profile["strategy"] picks one of ec.jax_backend.STRATEGIES (lrc
+propagates both into its layers; CEPH_TPU_EC_STRATEGY overrides all).
 """
 
 from __future__ import annotations

@@ -19,7 +19,7 @@ import itertools
 import numpy as np
 
 from ceph_tpu.ec import matrices
-from ceph_tpu.ec.gf import GF_MUL_TABLE, gf_invert_matrix, gf_matvec_data
+from ceph_tpu.ec.gf import GF_MUL_TABLE, gf_invert_matrix
 from ceph_tpu.ec.interface import ErasureCode, ErasureCodeProfileError
 
 
@@ -87,12 +87,15 @@ def shec_matrix(k: int, m: int, c: int, single: bool = False) -> np.ndarray:
 
 
 class ShecCode(ErasureCode):
-    """plugin=shec; profile: k=4, m=3, c=2, technique=multiple|single."""
+    """plugin=shec; profile: k=4, m=3, c=2, technique=multiple|single,
+    plus the shared backend/strategy engine knobs (the per-stripe
+    matmuls ride the same engines as ec.rs)."""
 
     def __init__(self):
         super().__init__()
         self.c = 0
         self.C: np.ndarray | None = None
+        self.engine = None
 
     def parse(self, profile: dict) -> None:
         self.k, self.m = 4, 3
@@ -115,10 +118,17 @@ class ShecCode(ErasureCode):
         self.C = shec_matrix(
             self.k, self.m, self.c, single=(technique == "single")
         )
+        from ceph_tpu.ec.rs import get_engine
+
+        self.engine = get_engine(
+            profile.get("backend", "numpy"), profile.get("strategy")
+        )
+        if hasattr(self.engine, "prepare"):
+            self.engine.prepare(self.C)
 
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
-        parity = gf_matvec_data(self.C, data)
-        return np.concatenate([data, parity], axis=0)
+        parity = np.asarray(self.engine.matmul(self.C, data))
+        return np.concatenate([np.asarray(data, np.uint8), parity], axis=0)
 
     # -- decoding: solve the shingled system --------------------------------
     def _plans(
@@ -179,7 +189,7 @@ class ShecCode(ErasureCode):
             rhs ^= GF_MUL_TABLE[
                 coef[:, None], np.asarray(chunks[j], np.uint8)[None, :]
             ]
-        sol = gf_matvec_data(inv, rhs)
+        sol = np.asarray(self.engine.matmul(inv, rhs))
         return {d: sol[i] for i, d in enumerate(cols)}
 
     def decode_chunks(
@@ -219,7 +229,9 @@ class ShecCode(ErasureCode):
             out.update(solved)
         if want_parity:
             data = np.stack([out[i] for i in range(self.k)])
-            par = gf_matvec_data(self.C[sorted(want_parity)], data)
+            par = np.asarray(
+                self.engine.matmul(self.C[sorted(want_parity)], data)
+            )
             for row, r in zip(par, sorted(want_parity)):
                 out[self.k + r] = row
         return out

@@ -62,6 +62,12 @@ class TestGF:
         assert gf_pow(5, 0) == 1
         assert gf_pow(0, 3) == 0
 
+    def test_xtime_is_mul_by_two(self):
+        from ceph_tpu.ec.gf import gf_xtime
+
+        x = np.arange(256, dtype=np.uint8)
+        assert np.array_equal(gf_xtime(x), gf_mul(x, 2))
+
     def test_matrix_inversion(self):
         rng = np.random.default_rng(9)
         for _ in range(20):
@@ -201,7 +207,9 @@ class TestInterface:
 
 
 class TestJaxEngine:
-    @pytest.mark.parametrize("strategy", ["logexp", "bitplane"])
+    @pytest.mark.parametrize(
+        "strategy", ["logexp", "bitplane", "xor", "xor_cse"]
+    )
     def test_matches_numpy(self, strategy, rng):
         from ceph_tpu.ec.jax_backend import JaxEngine
         from ceph_tpu.ec.rs import NumpyEngine

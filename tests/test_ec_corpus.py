@@ -35,6 +35,21 @@ def test_corpus_exists_and_covers_all_families():
         assert any(n.startswith(family) for n in names), family
 
 
+def test_corpus_pins_decode_under_erasure():
+    """v2 corpus: every entry carries digest-pinned decode cases, with
+    multi-loss patterns wherever the profile tolerates more than one
+    lost shard — decode PLANS are frozen, not just encode bytes."""
+    for e in _entries():
+        cases = e.get("decode")
+        assert cases, e["name"]
+        sizes = {len(c["erased"]) for c in cases}
+        assert 1 in sizes, e["name"]
+        # every frozen profile tolerates (and pins) multi-loss decodes
+        assert max(sizes) >= 2, (e["name"], sizes)
+        for c in cases:
+            assert len(c["digest"]) == 64
+
+
 @pytest.mark.parametrize("entry", _entries(), ids=lambda e: e["name"])
 def test_backends_pinned_to_corpus_bytes(entry):
     """Every available backend reproduces the frozen stripe digest and

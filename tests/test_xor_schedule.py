@@ -1,0 +1,361 @@
+"""XOR-schedule compiler + trace-once EC engine tests.
+
+Covers the PR-6 contract end to end: schedules lower once per matrix
+(CSE-deduplicated, bit-exact against the mul-table oracle), compiled
+executables key into `_EC_CACHE` like `_PIPE_CACHE` (hits proven at the
+counter level), decode plans cache per erasure pattern, batched-stripe
+kernels match per-stripe results, and the strategy knobs
+(CEPH_TPU_EC_STRATEGY, profile["strategy"], autotune) resolve as
+documented."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu import obs
+from ceph_tpu.ec import matrices
+from ceph_tpu.ec.gf import gf_matvec_data
+from ceph_tpu.ec.jax_backend import (
+    _AUTOTUNE,
+    _EC_CACHE,
+    STRATEGIES,
+    JaxEngine,
+)
+from ceph_tpu.ec.registry import create_erasure_code
+from ceph_tpu.ec.xor_schedule import (
+    _SCHEDULES,
+    bit_terms,
+    build_schedule,
+    host_apply,
+    matrix_key,
+)
+
+
+def _ec_counters() -> dict:
+    return dict(obs.perf_dump()["ec"])
+
+
+# -- the compiler -----------------------------------------------------------
+
+class TestScheduleCompiler:
+    def test_bit_terms_match_bitmatrix_semantics(self):
+        """Term (8i+j) in output r <=> bit j of M[r,i] — virtual row
+        8i+j carries 2^j·data[i]."""
+        M = np.array([[1, 2], [3, 255]], np.uint8)
+        terms = bit_terms(M)
+        assert terms[0] == [0, 9]            # 1·d0 ^ 2·d1
+        assert terms[1][:2] == [0, 1]        # 3 = bits 0,1
+        assert [t - 8 for t in terms[1][2:]] == list(range(8))  # 255
+
+    @pytest.mark.parametrize("k,m", [(4, 2), (8, 4), (6, 3)])
+    def test_host_apply_matches_oracle(self, k, m, rng):
+        """The CSE DAG (ops/outs, not the naive terms) reproduces the
+        table-driven GF matmul exactly."""
+        M = matrices.vandermonde_rs(k, m)
+        sched = build_schedule(M)
+        data = rng.integers(0, 256, (k, 1000)).astype(np.uint8)
+        assert np.array_equal(
+            host_apply(sched, data), gf_matvec_data(M, data)
+        )
+
+    def test_random_matrices_bit_exact(self, rng):
+        """Schedules are exact for arbitrary (not just MDS) matrices."""
+        for _ in range(5):
+            m, k = int(rng.integers(1, 5)), int(rng.integers(1, 7))
+            M = rng.integers(0, 256, (m, k)).astype(np.uint8)
+            data = rng.integers(0, 256, (k, 257)).astype(np.uint8)
+            sched = build_schedule(M)
+            assert np.array_equal(
+                host_apply(sched, data), gf_matvec_data(M, data)
+            ), M
+
+    def test_cse_reduces_xors(self):
+        """Paar dedup must strictly beat the naive program on the
+        headline RS(8,4) profile (~106 -> ~63 xors)."""
+        sched = build_schedule(matrices.vandermonde_rs(8, 4))
+        assert sched.n_xors_cse < sched.n_xors_naive
+        assert sched.stats()["temps"] > 0
+
+    def test_schedule_cached_per_matrix(self):
+        M = matrices.vandermonde_rs(5, 2)
+        before = _ec_counters()
+        s1 = build_schedule(M)
+        s2 = build_schedule(M.copy())
+        after = _ec_counters()
+        assert s1 is s2  # same object: keyed on content, not identity
+        assert matrix_key(M) in _SCHEDULES
+        assert after["xor_schedule_cache_hits"] > (
+            before["xor_schedule_cache_hits"]
+        )
+
+
+# -- the trace-once executable cache ---------------------------------------
+
+class TestEcCache:
+    def test_second_engine_hits_ec_cache(self, rng):
+        """Two engines, same matrix: the second's executor comes from
+        _EC_CACHE (a pipe_cache_hit, zero new jits) — the _PIPE_CACHE
+        contract applied to EC."""
+        M = matrices.cauchy_good(5, 3)
+        data = rng.integers(0, 256, (5, 2048)).astype(np.uint8)
+        e1 = JaxEngine("xor")
+        want = e1.matmul(M, data)
+        key = ("xor", matrix_key(M), False, False)
+        assert key in _EC_CACHE
+        before = _ec_counters()
+        e2 = JaxEngine("xor")
+        got = e2.matmul(M, data)
+        after = _ec_counters()
+        assert np.array_equal(got, want)
+        assert after["pipe_cache_hits"] > before["pipe_cache_hits"]
+
+    def test_stripes_do_not_recompile(self, rng):
+        """After one warm call, further stripes of the same shape book
+        zero compiles (jit cache-hit counters advance instead)."""
+        M = matrices.vandermonde_rs(4, 2)
+        eng = JaxEngine("xor")
+        data = rng.integers(0, 256, (4, 4096)).astype(np.uint8)
+        eng.matmul(M, data)  # warm
+        before = obs.jit_counters()
+        for _ in range(3):
+            eng.matmul(M, rng.integers(0, 256, (4, 4096)).astype(np.uint8))
+        delta = obs.jit_counters_delta(before)
+        assert delta["compiles"] == 0, delta
+        assert delta["cache_hits"] >= 3
+
+
+# -- decode plans -----------------------------------------------------------
+
+class TestDecodePlans:
+    def test_plan_cached_per_erasure_pattern(self, rng):
+        code = create_erasure_code(
+            {"plugin": "jax", "k": 4, "m": 2, "backend": "jax"}
+        )
+        data = rng.integers(0, 256, (4, 1024)).astype(np.uint8)
+        enc = np.asarray(code.encode_chunks(data))
+        n = 6
+        lost = [1, 4]
+        avail = {i: enc[i] for i in range(n) if i not in lost}
+        before = _ec_counters()
+        d1 = code.decode_chunks(set(lost), dict(avail), 1024)
+        mid = _ec_counters()
+        d2 = code.decode_chunks(set(lost), dict(avail), 1024)
+        after = _ec_counters()
+        for i in lost:
+            assert np.array_equal(np.asarray(d1[i]), enc[i])
+            assert np.array_equal(np.asarray(d2[i]), enc[i])
+        # first decode of the pattern builds the plan, the repeat hits
+        assert mid["decode_plan_misses"] > before["decode_plan_misses"]
+        assert after["decode_plan_hits"] > mid["decode_plan_hits"]
+        assert after["decode_plan_misses"] == mid["decode_plan_misses"]
+
+    def test_plans_shared_across_instances(self, rng):
+        """A second code with the same generator reuses the first's
+        plans (module-level cache keyed on matrix content)."""
+        prof = {"plugin": "jerasure", "k": 4, "m": 2}
+        c1 = create_erasure_code(dict(prof))
+        c2 = create_erasure_code(dict(prof))
+        data = rng.integers(0, 256, (4, 512)).astype(np.uint8)
+        enc = c1.encode_chunks(data)
+        avail = {i: enc[i] for i in range(6) if i != 2}
+        c1.decode_chunks({2}, dict(avail), 512)
+        before = _ec_counters()
+        c2.decode_chunks({2}, dict(avail), 512)
+        after = _ec_counters()
+        assert after["decode_plan_hits"] > before["decode_plan_hits"]
+
+
+# -- batched-stripe kernels -------------------------------------------------
+
+class TestBatched:
+    @pytest.mark.parametrize(
+        "strategy", ["xor", "xor_cse", "bitplane", "logexp", "pallas"]
+    )
+    def test_encode_batch_matches_per_stripe(self, strategy, rng):
+        """Batched == per-stripe for every strategy (pallas folds the
+        stripes axis into the byte axis: interpret-mode stays a couple
+        of grid steps, fast on CPU)."""
+        code = create_erasure_code(
+            {"plugin": "jax", "k": 4, "m": 2, "strategy": strategy}
+        )
+        batch = rng.integers(0, 256, (3, 4, 2048)).astype(np.uint8)
+        got = np.asarray(code.encode_batch(batch))
+        want = np.stack(
+            [np.asarray(code.encode_chunks(s)) for s in batch]
+        )
+        assert np.array_equal(got, want)
+
+    def test_encode_batch_zero_compiles_after_warm(self, rng):
+        code = create_erasure_code({"plugin": "jax", "k": 4, "m": 2})
+        batch = rng.integers(0, 256, (2, 4, 1024)).astype(np.uint8)
+        code.encode_batch(batch)  # warm
+        before = obs.jit_counters()
+        for _ in range(3):
+            code.encode_batch(
+                rng.integers(0, 256, (2, 4, 1024)).astype(np.uint8)
+            )
+        delta = obs.jit_counters_delta(before)
+        assert delta["compiles"] == 0, delta
+
+    def test_decode_batch_matches_per_stripe(self, rng):
+        code = create_erasure_code({"plugin": "jax", "k": 4, "m": 2})
+        batch = rng.integers(0, 256, (3, 4, 1024)).astype(np.uint8)
+        enc = np.asarray(code.encode_batch(batch))  # [3, 6, L]
+        lost = [0, 5]
+        chunks = {
+            i: enc[:, i] for i in range(6) if i not in lost
+        }
+        out = code.decode_batch(set(lost), dict(chunks), 1024)
+        for i in lost:
+            assert np.array_equal(np.asarray(out[i]), enc[:, i])
+
+    def test_numpy_engine_batch_fallback(self, rng):
+        """encode_batch works (loop fallback) for engines without a
+        batched kernel."""
+        code = create_erasure_code({"plugin": "jerasure", "k": 3, "m": 2})
+        batch = rng.integers(0, 256, (2, 3, 512)).astype(np.uint8)
+        got = code.encode_batch(batch)
+        want = np.stack([code.encode_chunks(s) for s in batch])
+        assert np.array_equal(got, want)
+
+
+# -- strategy knobs ---------------------------------------------------------
+
+class TestStrategyKnobs:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("CEPH_TPU_EC_STRATEGY", "bitplane")
+        assert JaxEngine().strategy == "bitplane"
+        monkeypatch.setenv("CEPH_TPU_EC_STRATEGY", "logexp")
+        assert JaxEngine().strategy == "logexp"
+        # the env is a FORCE: it overrides even explicit/profile picks
+        # (the documented way to pin one strategy fleet-wide)
+        assert JaxEngine("xor").strategy == "logexp"
+        code = create_erasure_code(
+            {"plugin": "jax", "k": 3, "m": 2, "strategy": "xor"}
+        )
+        assert code.engine.strategy == "logexp"
+        monkeypatch.delenv("CEPH_TPU_EC_STRATEGY")
+        assert JaxEngine("xor").strategy == "xor"
+
+    def test_env_override_rejected_when_unknown(self, monkeypatch):
+        monkeypatch.setenv("CEPH_TPU_EC_STRATEGY", "warp-drive")
+        with pytest.raises(ValueError, match="warp-drive"):
+            JaxEngine()
+
+    def test_profile_strategy_knob(self, rng):
+        from ceph_tpu.ec.interface import ErasureCodeProfileError
+
+        code = create_erasure_code(
+            {"plugin": "jax", "k": 3, "m": 2, "strategy": "bitplane"}
+        )
+        assert code.engine.strategy == "bitplane"
+        with pytest.raises(ErasureCodeProfileError):
+            create_erasure_code(
+                {"plugin": "jax", "k": 3, "m": 2, "strategy": "nope"}
+            )
+
+    def test_every_documented_strategy_exists(self):
+        assert set(STRATEGIES) == {
+            "xor", "xor_cse", "bitplane", "logexp", "pallas", "auto"
+        }
+
+    def test_autotune_resolves_and_caches(self, rng):
+        M = matrices.vandermonde_rs(3, 2)
+        data = rng.integers(0, 256, (3, 4096)).astype(np.uint8)
+        want = gf_matvec_data(M, data)
+        before = _ec_counters()
+        e1 = JaxEngine("auto")
+        assert np.array_equal(e1.matmul(M, data), want)
+        picked = e1._resolved_strategy
+        assert picked in STRATEGIES and picked != "auto"
+        mid = _ec_counters()
+        assert mid["autotunes"] > before["autotunes"]
+        # a second auto engine reuses the measured record: no new tune
+        e2 = JaxEngine("auto")
+        assert np.array_equal(e2.matmul(M, data), want)
+        after = _ec_counters()
+        assert after["autotunes"] == mid["autotunes"]
+        rec = _AUTOTUNE[(
+            __import__("jax").default_backend(), matrix_key(M)
+        )]
+        assert rec["strategy"] == picked
+        assert rec["measured_gbps"][picked] > 0
+
+
+# -- every strategy against the frozen corpus shapes ------------------------
+
+class TestStrategiesBitExact:
+    @pytest.mark.parametrize("strategy",
+                             ["xor", "xor_cse", "bitplane", "logexp",
+                              "pallas"])
+    def test_rs84_encode_decode(self, strategy, rng):
+        """All strategies produce identical stripes AND identical
+        decode-plan rebuilds on the headline RS(8,4) shape."""
+        code = create_erasure_code(
+            {"plugin": "jax", "k": 8, "m": 4, "strategy": strategy}
+        )
+        oracle = create_erasure_code({"plugin": "jerasure",
+                                      "k": 8, "m": 4})
+        data = rng.integers(0, 256, (8, 4096)).astype(np.uint8)
+        enc = np.asarray(code.encode_chunks(data))
+        assert np.array_equal(enc, oracle.encode_chunks(data)), strategy
+        lost = [0, 5, 9]
+        avail = {i: enc[i] for i in range(12) if i not in lost}
+        dec = code.decode_chunks(set(lost), dict(avail), 4096)
+        for i in lost:
+            assert np.array_equal(np.asarray(dec[i]), enc[i]), (
+                strategy, i
+            )
+
+
+# -- clay product-matrix repair plans --------------------------------------
+
+class TestClayRepairPlan:
+    def test_repair_plan_cached_and_exact(self, rng):
+        code = create_erasure_code(
+            {"plugin": "clay", "k": 4, "m": 2, "d": "5"}
+        )
+        sub = code.get_sub_chunk_count()
+        L = 64 * sub
+        data = rng.integers(0, 256, (4, L)).astype(np.uint8)
+        enc = code.encode_chunks(data)
+        want = {2}
+        need = code.minimum_to_repair(want, set(range(6)) - want)
+        helpers = {}
+        for j, runs in need.items():
+            arr = enc[j].reshape(sub, -1)
+            planes = [z for ind, cnt in runs for z in range(ind, ind + cnt)]
+            helpers[j] = np.ascontiguousarray(arr[planes]).reshape(-1)
+        before = _ec_counters()
+        out1 = code.repair(want, dict(helpers), L)
+        mid = _ec_counters()
+        out2 = code.repair(want, dict(helpers), L)
+        after = _ec_counters()
+        assert np.array_equal(out1[2], enc[2])
+        assert np.array_equal(out2[2], enc[2])
+        assert mid["repair_plan_misses"] > before["repair_plan_misses"]
+        assert after["repair_plan_hits"] > mid["repair_plan_hits"]
+
+
+# -- at-scale variants (tier-1 budget: slow-marked) -------------------------
+
+@pytest.mark.slow
+class TestAtScale:
+    def test_big_stripe_all_strategies(self, rng):
+        data = rng.integers(0, 256, (8, 1 << 20)).astype(np.uint8)
+        oracle = gf_matvec_data(matrices.vandermonde_rs(8, 4), data)
+        for strategy in ("xor", "xor_cse", "bitplane", "logexp"):
+            eng = JaxEngine(strategy)
+            got = eng.matmul(matrices.vandermonde_rs(8, 4), data)
+            assert np.array_equal(got, oracle), strategy
+
+    def test_big_batched_vmap_zero_compiles(self, rng):
+        code = create_erasure_code({"plugin": "jax", "k": 8, "m": 4})
+        batch = rng.integers(0, 256, (8, 8, 1 << 17)).astype(np.uint8)
+        code.encode_batch(batch[:2])  # warm the 2-stripe shape
+        code.encode_batch(batch)      # warm the 8-stripe shape
+        before = obs.jit_counters()
+        out = np.asarray(code.encode_batch(batch))
+        delta = obs.jit_counters_delta(before)
+        assert delta["compiles"] == 0, delta
+        want = np.asarray(code.encode_chunks(batch[3]))
+        assert np.array_equal(out[3], want)

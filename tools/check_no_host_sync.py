@@ -1,16 +1,20 @@
-"""Lint: no host syncs inside the pipeline dispatch spans.
+"""Lint: no host syncs inside the dispatch spans.
 
-`pipeline.map_block` and `pipeline.rescue` spans time DISPATCH — the
-enqueue of already-compiled work onto the device.  A `np.asarray(...)`,
-`.item()` or `float(...)` on a traced value inside one of those bodies
-blocks on the device and silently turns the span into a transfer
-measurement (the exact bug that made r05's per-block numbers
-fetch-bound); the fetch belongs in `pipeline.fetch` (or between the
-spans, as the unresolved-flag read in PoolMapper._map_block_inner does).
+`pipeline.map_block`, `pipeline.rescue` and the EC engine's
+`ec.gf_dispatch` spans time DISPATCH — the enqueue of already-compiled
+work onto the device.  A `np.asarray(...)`, `.item()` or `float(...)`
+on a traced value inside one of those bodies blocks on the device and
+silently turns the span into a transfer measurement (the exact bug
+that made r05's per-block numbers fetch-bound, and that made the EC
+engine's old dispatch span time the d2h fetch of every host-facing
+matmul); the fetch belongs in `pipeline.fetch` / `ec.gf_fetch` (or
+between the spans, as the unresolved-flag read in
+PoolMapper._map_block_inner does).
 
 This lint walks the AST of every hot-path module plus bench.py and
 flags, inside any `with obs.span("pipeline.map_block"...)` /
-`obs.span("pipeline.rescue"...)` body:
+`obs.span("pipeline.rescue"...)` / `obs.span("ec.gf_dispatch"...)`
+body:
 
     np.asarray(...) / np.array(...) / numpy.asarray(...)
     <expr>.item()
@@ -34,7 +38,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
 
-SPAN_NAMES = ("pipeline.map_block", "pipeline.rescue")
+SPAN_NAMES = ("pipeline.map_block", "pipeline.rescue", "ec.gf_dispatch")
 
 SCAN = (
     "ceph_tpu",

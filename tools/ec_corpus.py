@@ -6,7 +6,10 @@ content that newer versions must reproduce byte-for-byte
 create/check round trips over a --base directory).  Same idea here, one
 JSON file instead of a directory tree: for each profile the corpus
 records the SHA-256 of the full encoded stripe for a deterministic
-input, plus erasure sets that must decode back to the original bytes.
+input, plus decode-under-erasure cases (1..m lost shards, deterministic
+patterns clipped to each profile's actual tolerance) whose REBUILT
+bytes are digest-pinned too — decode plans are frozen bit-exact, not
+just encode.
 
 Every *backend* of a plugin (host numpy, the native SIMD engine, the
 device jax engine) must produce the SAME stripe — the corpus digest is
@@ -50,18 +53,41 @@ ENTRIES: list[tuple[str, dict, tuple[str, ...]]] = [
      ("numpy", "native", "jax")),
     ("clay_k4m2_d5",
      {"plugin": "clay", "k": "4", "m": "2", "d": "5"},
-     ("numpy", "native")),
+     ("numpy", "native", "jax")),
     ("shec_k4m3_c2",
      {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
-     ("numpy",)),
+     ("numpy", "native", "jax")),
     ("lrc_k4m2_l3",
      {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
-     ("numpy",)),
+     ("numpy", "native", "jax")),
 ]
 
 # erasure sets (chunk indices) each entry must decode through; clipped
 # to the entry's chunk count and fault tolerance at run time
 ERASURES = ([0], [1, 5])
+
+
+def decode_patterns(n: int, m: int) -> list[list[int]]:
+    """Deterministic erasure patterns, 1..m lost shards: for each loss
+    count a leading run (data-heavy), an evenly spread set, and a tail
+    run (parity-heavy).  Patterns a profile cannot decode (shec's c <
+    m tolerance, lrc layer limits) are dropped at `create` time by
+    attempting the decode — what lands in the corpus is exactly what
+    every backend must then reproduce."""
+    out: list[list[int]] = []
+    seen: set[tuple] = set()
+    for lost_n in range(1, m + 1):
+        cands = (
+            list(range(lost_n)),                              # leading
+            sorted({(i * n) // lost_n for i in range(lost_n)}),  # spread
+            list(range(n - lost_n, n)),                       # tail
+        )
+        for p in cands:
+            p = sorted(set(p))
+            if len(p) == lost_n and tuple(p) not in seen:
+                seen.add(tuple(p))
+                out.append(p)
+    return out
 
 DEFAULT_CORPUS = Path(__file__).resolve().parent.parent / "tests" / \
     "data" / "ec_corpus.json"
@@ -115,6 +141,14 @@ def _stripe_digest(chunks: np.ndarray) -> str:
     return h.hexdigest()
 
 
+def _rebuilt_digest(dec: dict, erased: list[int]) -> str:
+    """Digest of the REBUILT chunks only, in erased-index order."""
+    h = hashlib.sha256()
+    for i in erased:
+        h.update(_to_np(dec[i]).tobytes())
+    return h.hexdigest()
+
+
 def build_entry(name: str, profile: dict, nbytes: int) -> dict:
     code = _mk_code(profile, "numpy")
     k = code.k
@@ -123,18 +157,33 @@ def build_entry(name: str, profile: dict, nbytes: int) -> dict:
     data = _data_for(name, k, L)
     enc = _encode(code, data, "numpy")
     assert enc.shape[0] == n, (name, enc.shape, n)
+    decode_cases = []
+    for erased in decode_patterns(n, code.get_coding_chunk_count()):
+        avail = {i: enc[i] for i in range(n) if i not in erased}
+        try:
+            dec = code.decode_chunks(set(erased), dict(avail), L)
+        except Exception:
+            continue  # beyond this profile's tolerance: not a case
+        for i in erased:  # a wrong rebuild must never be frozen
+            assert np.array_equal(_to_np(dec[i]), enc[i]), (name, erased, i)
+        decode_cases.append({
+            "erased": list(erased),
+            "digest": _rebuilt_digest(dec, erased),
+        })
+    assert decode_cases, name  # every profile pins at least one decode
     return {
         "name": name,
         "profile": profile,
         "chunk_bytes": L,
         "n_chunks": n,
         "digest": _stripe_digest(enc),
+        "decode": decode_cases,
     }
 
 
 def create(path: Path, nbytes: int) -> None:
     corpus = {
-        "version": 1,
+        "version": 2,
         "entries": [
             build_entry(name, profile, nbytes)
             for name, profile, _ in ENTRIES
@@ -207,6 +256,28 @@ def verify_entry(entry: dict, backends: tuple[str, ...],
                         f"{name}[{backend}]: decode{erased} chunk {i} "
                         "bytes differ"
                     )
+        # frozen decode-under-erasure cases: the rebuilt bytes of every
+        # recorded pattern must reproduce the pinned digest — this is
+        # what holds decode PLANS (cached inverses + schedules)
+        # bit-exact, not just the encode path
+        for case in entry.get("decode", ()):
+            erased = list(case["erased"])
+            avail = {
+                i: _to_np(enc[i]) for i in range(n) if i not in erased
+            }
+            try:
+                dec = code.decode_chunks(set(erased), avail, L)
+            except Exception as e:
+                problems.append(
+                    f"{name}[{backend}]: decode case {erased} raised: {e}"
+                )
+                continue
+            got = _rebuilt_digest(dec, erased)
+            if got != case["digest"]:
+                problems.append(
+                    f"{name}[{backend}]: decode case {erased} digest "
+                    f"{got[:16]}... != corpus {case['digest'][:16]}..."
+                )
     if ran == 0:
         problems.append(f"{name}: no requested backend available")
     return problems
