@@ -227,7 +227,7 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
         if unresolved:
             pl.inc("rescue_invocations")
             # flag fetch + host index math BEFORE the span: the rescue
-            # span times dispatch only (tools/check_no_host_sync.py)
+            # span times dispatch only (graftlint host-sync pass)
             rescue_xs = []
             for bi, f in enumerate(flags):
                 fv = np.asarray(f)
@@ -1006,6 +1006,34 @@ SELFTEST_STAGES = (
 )
 
 
+def _selftest_graftlint(problems: list[str]) -> dict:
+    """All graftlint passes over the whole repo, JSON report embedded in
+    the selftest record: contract drift (an undeclared counter, a span
+    typo, a kernel baking a table into its trace) fails the same fast
+    CPU gate that guards the survivability path, instead of surfacing as
+    the next r05-style bench post-mortem."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--json"],
+            capture_output=True, text=True, timeout=120, cwd=_HERE,
+        )
+        rep = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
+        problems.append(f"graftlint did not produce a report: {e!r}")
+        return {"error": str(e)}
+    if proc.returncode != 0 or rep.get("count", -1) != 0:
+        problems.append(
+            f"graftlint: {rep.get('count')} violation(s): "
+            + "; ".join(
+                v["path"] + ":" + str(v["line"]) + " " + v["pass"]
+                for v in rep.get("violations", [])[:5]
+            )
+        )
+    # the full pass list + zero count is the record of what was checked
+    return {k: rep[k] for k in ("passes", "files_scanned", "count",
+                                "elapsed_s") if k in rep}
+
+
 def selftest() -> int:
     """<60s CPU-only survivability check: inject a TPU-init hang, then
     require that EVERY stage (including a miniature rebalance) completes
@@ -1051,6 +1079,7 @@ def selftest() -> int:
             problems.append(f"attempts={out.get('attempts')}, wanted >=2")
         if not out.get("value", 0) > 0:
             problems.append("headline value is zero")
+    lint = _selftest_graftlint(problems)
     verdict = {
         "selftest": "ok" if not problems else "FAIL",
         "elapsed_s": round(time.time() - t0, 1),
@@ -1058,6 +1087,7 @@ def selftest() -> int:
         "backend": out.get("backend"),
         "fallback_reason": out.get("fallback_reason"),
         "attempts": out.get("attempts"),
+        "graftlint": lint,
     }
     if problems:
         verdict["problems"] = problems

@@ -28,7 +28,7 @@ Importing this package is cheap (no jax import) and, when
 
 from __future__ import annotations
 
-from ceph_tpu.obs import trace
+from ceph_tpu.obs import spans, trace
 from ceph_tpu.obs.admin_socket import maybe_start_from_env
 from ceph_tpu.obs.jax_accounting import JitAccount, timed_fetch
 from ceph_tpu.obs.trace import (
@@ -101,6 +101,7 @@ __all__ = [
     "reset_values",
     "set_trace_path",
     "span",
+    "spans",
     "timed_fetch",
     "trace",
     "trace_path",

@@ -1,0 +1,106 @@
+"""Span registry — the single authoritative list of trace event names.
+
+Every `obs.span("...")` / `obs.instant("...")` / `obs.counter("...")`
+name in the tree must be declared here: a typo'd span name silently
+orphans its trace events (nothing fails, Perfetto just shows a stray
+track nobody is looking for), and the no-host-sync lint used to carry
+its own hardcoded tuple of dispatch spans that could drift from the
+instrumented code.  `tools/graftlint` (the `span-name` pass) checks the
+literal call sites against this module statically, and the `host-sync`
+pass takes the dispatch-span set from `DISPATCH_SPANS` instead of a
+private copy.
+
+Three kinds of entry:
+
+- `SPANS`: complete ("ph":"X") span names -> one-line doc.  Names that
+  serve as a base for derived events (JitAccount appends `.compile` /
+  `.dispatch` / `.fetch`) are still declared once, by the base name.
+- `INSTANTS` / `COUNTERS`: zero-duration markers and counter tracks.
+- `PREFIXES`: allowed prefixes for dynamically built span names
+  (f-strings); the static head of the f-string must match one of these.
+  JitAccount's fully dynamic `f"{group}.{key}.{phase}"` names carry no
+  static head and are exempt from the lint by construction.
+
+Keep this module import-light: graftlint parses it as an AST (no
+import), and `obs` re-exports it for runtime introspection.
+"""
+
+from __future__ import annotations
+
+SPANS: dict[str, str] = {
+    # osd/pipeline_jax.py + bench.py - the batched mapping pipeline
+    "pipeline.map_block": "dispatch of one jitted fast-path block",
+    "pipeline.rescue": "dispatch of exact-loop recompute of flagged lanes",
+    "pipeline.fetch": "d2h fetch of finished mapping results",
+    # bench.py drivers
+    "bench.cold_pass": "first full mapping pass (includes compiles)",
+    "bench.warm_pass": "steady-state full mapping pass",
+    "bench.balancer": "balancer bench stage body",
+    # balancer/
+    "balancer.map_pool": "DeviceState full-pool mapping pass",
+    "balancer.pgs_of": "device membership query for one OSD",
+    "balancer.build_state": "O(PGs) membership-state build",
+    "balancer.round": "one greedy upmap optimizer round",
+    # mgr/
+    "mgr.map_pool": "eval distribution mapping pass for one pool",
+    "mgr.pool_counts": "per-OSD pg/object/byte count reduction",
+    "mgr.calc_eval": "full eval scoring pass",
+    "mgr.optimize": "one Balancer.optimize() call",
+    "mgr.do_upmap_pool": "upmap optimization of one pool",
+    "mgr.execute": "plan application through apply_incremental",
+    # ec/
+    "ec.encode": "RS encode_chunks call",
+    "ec.decode": "RS decode_chunks call",
+    "ec.encode_batch": "batched multi-stripe encode",
+    "ec.decode_batch": "batched multi-stripe decode",
+    "ec.clay_encode": "Clay encode_chunks call",
+    "ec.clay_decode": "Clay decode_chunks call",
+    "ec.clay_repair": "Clay minimum-bandwidth single-chunk repair",
+    "ec.gf_dispatch": "GF kernel dispatch (device work only)",
+    # JitAccount span= bases (derived: .compile / .dispatch / .fetch)
+    "ec.gf_matmul": "instrumented GF matmul entry (JitAccount base)",
+    "ec.gf_matmul_batch": "instrumented batched GF matmul (JitAccount base)",
+    # runtime/
+    "runtime.acquire_backend": "ladder descent to a healthy backend",
+    "runtime.probe": "one watchdogged device preflight probe",
+    # cli/
+    "daemon.selftest": "daemon CLI miniature workload",
+    # tools/perf_probe.py
+    "probe.scaling": "perf-probe block-size scaling sweep",
+    "probe.ablations": "perf-probe ablation sweep",
+    "probe.trace": "perf-probe traced demonstration run",
+}
+
+INSTANTS: dict[str, str] = {
+    "fault.fired": "an armed fault point fired",
+    "stage.overrun": "a stage was abandoned by the watchdog",
+    "runtime.acquired": "backend acquisition finished",
+    "sharded.make_mesh": "device mesh construction",
+}
+
+COUNTERS: dict[str, str] = {
+    "balancer.stddev": "deviation trajectory across optimizer rounds",
+    "mgr.score": "eval score after each calc_eval",
+}
+
+# f-string span names must start with one of these static heads
+PREFIXES: tuple[str, ...] = (
+    "stage.",  # runtime/scheduler.py: f"stage.{stage_name}"
+)
+
+# spans that time DISPATCH only: enqueue of already-compiled device work.
+# The graftlint `host-sync` pass forbids host syncs inside their bodies;
+# fetches belong in pipeline.fetch / ec.gf_fetch or between spans.
+DISPATCH_SPANS: tuple[str, ...] = (
+    "pipeline.map_block",
+    "pipeline.rescue",
+    "ec.gf_dispatch",
+)
+
+
+def known(name: str) -> bool:
+    """True if `name` is a declared event name or matches a dynamic
+    prefix (runtime helper; the lint does the same check statically)."""
+    if name in SPANS or name in INSTANTS or name in COUNTERS:
+        return True
+    return any(name.startswith(p) for p in PREFIXES)

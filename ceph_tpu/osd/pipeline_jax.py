@@ -613,7 +613,7 @@ class PoolMapper:
             raise
 
     def _map_block_inner(self, ps: np.ndarray, n: int):
-        # span contract (tools/check_no_host_sync.py): map_block and
+        # span contract (graftlint host-sync pass): map_block and
         # rescue time DISPATCH only — no np.asarray/.item()/float() on
         # traced values inside them.  The unresolved-flag fetch sits
         # between the spans; result rows stay on device (rescued lanes

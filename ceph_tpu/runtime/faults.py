@@ -15,7 +15,7 @@ Spec syntax (env `CEPH_TPU_FAULTS`, comma-separated):
 
     CEPH_TPU_FAULTS="init.tpu=hang:600"        # TPU init hangs 600s
     CEPH_TPU_FAULTS="init.tpu=fail:ENOLINK x2" # first 2 probes raise
-    CEPH_TPU_FAULTS="compile=stall:3"          # compile stalls 3s
+    CEPH_TPU_FAULTS="stage.headline=stall:3"   # stage start stalls 3s
     CEPH_TPU_FAULTS="map_batch=lost x1"        # device loss, once
     CEPH_TPU_FAULTS="stage_end.ec_jax=exit:3"  # die after a checkpoint
     CEPH_TPU_FAULTS="stage.headline=overrun:9" # stage overruns 9s
@@ -51,6 +51,20 @@ import time
 from ceph_tpu.utils.dout import subsys_logger
 
 ENV_VAR = "CEPH_TPU_FAULTS"
+
+# The declared fault points: every compiled-in `check(point, ...)` site
+# must use one of these bases, and every base must be exercised by at
+# least one test (both checked statically by the graftlint `fault-point`
+# pass — an unexercised fault point is a retry/degradation branch nobody
+# runs until a real device wedges).  Tests may still arm ad-hoc points
+# (e.g. qualifier-mismatch probes); only production call sites are held
+# to the registry.
+FAULT_POINTS: dict[str, str] = {
+    "init": "backend preflight probe (qualifier: platform rung)",
+    "map_batch": "mid-batch device dispatch in the mapping pipeline",
+    "stage": "scheduler stage body start (qualifier: stage name)",
+    "stage_end": "after a stage checkpoints (qualifier: stage name)",
+}
 
 _log = subsys_logger("runtime")
 _lock = threading.Lock()

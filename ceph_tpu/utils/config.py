@@ -103,7 +103,9 @@ class Config:
 
     def _load_env(self) -> None:
         for name, opt in OPTIONS.items():
-            raw = os.environ.get(ENV_PREFIX + name.upper())
+            # the CEPH_TPU_<OPTION> family is documented by the OPTIONS
+            # table above, not the knob registry (one entry per Option)
+            raw = os.environ.get(ENV_PREFIX + name.upper())  # graftlint: disable=env-knob
             if raw is not None:
                 self._values[name] = _coerce(opt, raw)
 

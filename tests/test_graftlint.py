@@ -1,0 +1,462 @@
+"""graftlint: every pass fires on its seeded-violation fixture, stays
+silent on the negative control, suppressions work, the reporters keep
+their shape, and the whole repo scans clean (that last one IS the
+contract gate: dispatch spans don't sync, kernels don't bake tables,
+counters/spans/knobs/fault-points match their registries)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import PASSES, Context, Module, run
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def lint(tmp_path, src: str, pass_name: str, name: str = "fixture.py"):
+    """Run one pass over one fixture file; returns violations."""
+    p = tmp_path / name
+    p.write_text(src)
+    ctx = Context(paths=[], include_tests=False)  # real registries, no scan
+    return PASSES[pass_name].check_module(Module(p, REPO), ctx)
+
+
+# -- no-print ---------------------------------------------------------------
+
+def test_no_print_fires(tmp_path):
+    v = lint(tmp_path, (
+        "import sys\n"
+        "print('a')\n"
+        "print('b', file=sys.stdout)\n"
+    ), "no-print")
+    assert [x.line for x in v] == [2, 3]
+
+
+def test_no_print_clean(tmp_path):
+    v = lint(tmp_path, (
+        "import sys\n"
+        "print('c', file=sys.stderr)\n"
+        "print('d', file=w)\n"
+    ), "no-print")
+    assert v == []
+
+
+# -- host-sync --------------------------------------------------------------
+
+def test_host_sync_fires_alias_aware(tmp_path):
+    v = lint(tmp_path, (
+        "import numpy as xnp\n"
+        "from numpy import asarray as aa\n"
+        "import jax\n"
+        "with obs.span('pipeline.map_block', pgs=1):\n"
+        "    a = xnp.asarray(x)\n"          # aliased module
+        "    b = aa(x)\n"                   # from-import alias
+        "    c = int(x.sum())\n"            # int() joined the sync list
+        "    d = jax.device_get(x)\n"
+        "    e = x.block_until_ready()\n"
+        "with obs.span('ec.gf_dispatch'):\n"
+        "    f = bool(flg)\n"
+    ), "host-sync")
+    assert [x.line for x in v] == [5, 6, 7, 8, 9, 11]
+    assert "numpy.asarray()" in v[0].message
+    assert "ec.gf_dispatch" in v[5].message
+
+
+def test_host_sync_reports_every_span_item(tmp_path):
+    # the old walker reported spans[0] only; both names must show up
+    v = lint(tmp_path, (
+        "with obs.span('pipeline.map_block'), obs.span('pipeline.rescue'):\n"
+        "    a = float(x)\n"
+    ), "host-sync")
+    assert len(v) == 1
+    assert "pipeline.map_block" in v[0].message
+    assert "pipeline.rescue" in v[0].message
+
+
+def test_host_sync_clean(tmp_path):
+    v = lint(tmp_path, (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "n = int(x)\n"                       # outside any span
+        "with obs.span('pipeline.map_block'):\n"
+        "    a = jnp.asarray(x)\n"            # device op, not a sync
+        "    b = np.resize(x, 4)\n"           # host alloc, not a sync
+        "with obs.span('pipeline.fetch'):\n"
+        "    c = np.asarray(x)\n"              # fetch span: allowed
+        "with obs.span('bench.cold_pass'):\n"
+        "    d = float(x)\n"                   # not a dispatch span
+    ), "host-sync")
+    assert v == []
+
+
+# -- trace-constant ---------------------------------------------------------
+
+def test_trace_constant_fires_on_closure(tmp_path):
+    v = lint(tmp_path, (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def build(n):\n"
+        "    table = np.arange(n)\n"
+        "    @jax.jit\n"
+        "    def kern(x):\n"
+        "        return x + table\n"          # closure -> trace constant
+        "    return kern\n"
+    ), "trace-constant")
+    assert len(v) == 1 and "table" in v[0].message
+
+
+def test_trace_constant_fires_on_asarray_of_free_var(tmp_path):
+    v = lint(tmp_path, (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def build(data):\n"
+        "    @jax.jit\n"
+        "    def kern(x):\n"
+        "        return x + jnp.asarray(data)\n"
+        "    return kern\n"
+    ), "trace-constant")
+    assert len(v) == 1 and "data" in v[0].message
+
+
+def test_trace_constant_fires_through_jit_call_and_vmap(tmp_path):
+    v = lint(tmp_path, (
+        "import jax\n"
+        "import numpy as np\n"
+        "def build(n):\n"
+        "    w = np.zeros(n)\n"
+        "    def kern(x):\n"
+        "        return x * w\n"
+        "    return jax.jit(jax.vmap(kern))\n"
+    ), "trace-constant")
+    assert len(v) == 1 and "'w'" in v[0].message
+
+
+def test_trace_constant_clean_operand_style(tmp_path):
+    v = lint(tmp_path, (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def build(n):\n"
+        "    table = np.arange(n)\n"
+        "    @jax.jit\n"
+        "    def kern(x, tb):\n"              # table rides as an operand
+        "        return x + tb\n"
+        "    def run(x):\n"
+        "        return kern(jnp.asarray(x), jnp.asarray(table))\n"
+        "    return run\n"                    # asarray outside jit: fine
+    ), "trace-constant")
+    assert v == []
+
+
+# -- counter-decl -----------------------------------------------------------
+
+def test_counter_decl_fires_on_typo(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "L = obs.logger_for('fixg')\n"
+        "L.add_u64('ok', 'fine')\n"
+        "L.inc('ok')\n"
+        "L.inc('typo')\n"
+    ), "counter-decl")
+    assert len(v) == 1 and v[0].line == 5 and "'typo'" in v[0].message
+
+
+def test_counter_decl_resolves_function_returning_logger(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "def _c():\n"
+        "    L = obs.logger_for('fixg')\n"
+        "    L.add_u64('hits', '')\n"
+        "    return L\n"
+        "_c().inc('hits')\n"
+        "_c().inc('misses')\n"
+    ), "counter-decl")
+    assert len(v) == 1 and v[0].line == 7 and "'misses'" in v[0].message
+
+
+def test_counter_decl_dynamic_suffix_family(tmp_path):
+    # JitAccount-style f-string declares allow endswith-matched updates
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "L = obs.logger_for('fixg')\n"
+        "def declare(key):\n"
+        "    L.add_u64(f'{key}_things', '')\n"
+        "L.inc('foo_things')\n"
+        "L.inc('foo_stuff')\n"
+    ), "counter-decl")
+    assert len(v) == 1 and v[0].line == 6
+
+
+def test_counter_decl_observe_and_time(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "L = obs.logger_for('fixg')\n"
+        "L.add_time_avg('lat', '')\n"
+        "with L.time('lat'):\n"
+        "    pass\n"
+        "L.observe('lat', 0.5)\n"
+        "L.observe('latency', 0.5)\n"
+    ), "counter-decl")
+    assert len(v) == 1 and v[0].line == 7
+
+
+# -- env-knob ---------------------------------------------------------------
+
+def test_env_knob_fires_on_unregistered(tmp_path):
+    v = lint(tmp_path, (
+        "import os\n"
+        "a = os.environ.get('CEPH_TPU_BOGUS_KNOB')\n"
+        "b = os.environ['CEPH_TPU_ALSO_BOGUS']\n"
+        "c = 'CEPH_TPU_THIRD_BOGUS' in os.environ\n"
+    ), "env-knob")
+    assert [x.line for x in v] == [2, 3, 4]
+
+
+def test_env_knob_fires_on_dynamic_key(tmp_path):
+    v = lint(tmp_path, (
+        "import os\n"
+        "PREFIX = 'CEPH_TPU_'\n"
+        "x = os.environ.get(PREFIX + name)\n"
+    ), "env-knob")
+    assert len(v) == 1 and "dynamic" in v[0].message
+
+
+def test_env_knob_sees_registry_reader(tmp_path):
+    # knobs.get() is the registry's own checked reader: a bogus name
+    # fires, a registered one is silent (and counts as a read)
+    v = lint(tmp_path, (
+        "from ceph_tpu.utils import knobs\n"
+        "a = knobs.get('CEPH_TPU_TRACE')\n"
+        "b = knobs.get('CEPH_TPU_BOGUS_KNOB')\n"
+    ), "env-knob")
+    assert [x.line for x in v] == [3]
+
+
+def test_env_knob_clean(tmp_path):
+    v = lint(tmp_path, (
+        "import os\n"
+        "from os import environ\n"
+        "ENV_VAR = 'CEPH_TPU_FAULTS'\n"
+        "a = os.environ.get('CEPH_TPU_TRACE')\n"   # registered
+        "b = environ.get(ENV_VAR)\n"                # via constant: registered
+        "c = os.environ.get('BENCH_PGS')\n"         # not a CEPH_TPU knob
+        "d = os.environ.get('XLA_FLAGS', '')\n"
+    ), "env-knob")
+    assert v == []
+
+
+# -- span-name --------------------------------------------------------------
+
+def test_span_name_fires_on_typo(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "with obs.span('pipeline.map_blok'):\n"
+        "    pass\n"
+        "obs.instant('no.such_marker')\n"
+        "obs.counter('no.such_track', 1.0)\n"
+    ), "span-name")
+    assert sorted(x.line for x in v) == [2, 4, 5]
+
+
+def test_span_name_fires_on_unregistered_fstring_prefix(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "with obs.span(f'bogus.{x}'):\n"
+        "    pass\n"
+    ), "span-name")
+    assert len(v) == 1 and "bogus.{...}" in v[0].message
+
+
+def test_span_name_clean(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "with obs.span('pipeline.map_block', pgs=1):\n"
+        "    pass\n"
+        "with obs.span(f'stage.{name}'):\n"        # registered prefix
+        "    pass\n"
+        "with obs.span(f'{group}.{key}.dispatch'):\n"  # no static head
+        "    pass\n"
+        "with obs.span(variable):\n"                # not statically checkable
+        "    pass\n"
+        "obs.instant('fault.fired', point='x')\n"
+        "obs.counter('balancer.stddev', 1.0)\n"
+        "time.perf_counter()\n"                     # not a trace counter
+    ), "span-name")
+    assert v == []
+
+
+def test_span_name_checks_jitaccount_base(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "f = obs.JitAccount(fn, L, 'k', span='ec.gf_matmul')\n"
+        "g = obs.JitAccount(fn, L, 'k', span='ec.gf_matmull')\n"
+    ), "span-name")
+    assert len(v) == 1 and v[0].line == 3
+
+
+# -- fault-point ------------------------------------------------------------
+
+def test_fault_point_fires_on_undeclared_base(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu.runtime import faults\n"
+        "faults.check('bogus_point')\n"
+        "SPEC = {'CEPH_TPU_FAULTS': 'nonexistent=fail:x x1'}\n"
+    ), "fault-point")
+    assert [x.line for x in v] == [2, 3]
+    assert "bogus_point" in v[0].message
+
+
+def test_fault_point_clean(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu.runtime import faults\n"
+        "faults.check('map_batch')\n"
+        "faults.check('init', qual='tpu')\n"
+        "SPEC = 'init.auto=hang:600,stage_end.ec_jax=exit:3'\n"
+        "NOT_A_SPEC = 'a=b,c=d'\n"             # unknown action: not a spec
+    ), "fault-point")
+    assert v == []
+
+
+def test_fault_point_flags_untested_declared_point():
+    ctx = Context(paths=[])  # parses tests/, no scanned modules
+    ctx.fault_points = dict(ctx.fault_points, zz_unused="never exercised")
+    ctx.fault_lines["zz_unused"] = 1
+    PASSES["fault-point"].run(ctx)
+    msgs = [v.message for v in ctx.violations]
+    assert any("zz_unused" in m for m in msgs)
+    # the real points are all exercised by the suite
+    assert not any("'init'" in m or "'map_batch'" in m or "'stage'" in m
+                   or "'stage_end'" in m for m in msgs)
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppression_silences_one_pass(tmp_path):
+    src = (
+        "import numpy as np\n"
+        "with obs.span('pipeline.map_block'):\n"
+        "    a = np.asarray(x)  # graftlint: disable=host-sync\n"
+        "    b = np.asarray(x)  # graftlint: disable=all\n"
+        "    c = np.asarray(x)  # graftlint: disable=span-name\n"
+    )
+    v = lint(tmp_path, src, "host-sync")
+    assert [x.line for x in v] == [5]  # wrong pass name does not suppress
+
+
+def test_shim_find_violations_honors_root(tmp_path):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_no_print import find_violations
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "ceph_tpu" / "osd"
+    bad.mkdir(parents=True)
+    (bad / "bad.py").write_text("print('oops')\n")
+    v = find_violations(tmp_path)
+    assert len(v) == 1 and "bad.py" in v[0]
+
+
+# -- registries stay self-consistent ---------------------------------------
+
+def test_span_registry_shape():
+    from ceph_tpu.obs import spans
+
+    assert set(spans.DISPATCH_SPANS) <= set(spans.SPANS)
+    assert spans.known("pipeline.map_block")
+    assert spans.known("stage.anything")
+    assert not spans.known("pipeline.map_blok")
+
+
+def test_knob_registry_and_readme_table():
+    from ceph_tpu.utils import knobs
+
+    table = knobs.render_table()
+    readme = (REPO / "README.md").read_text()
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in table
+        assert name in readme, f"{name} missing from README knob table"
+    with pytest.raises(KeyError):
+        knobs.get("CEPH_TPU_NOT_A_KNOB")
+    assert knobs.get("CEPH_TPU_TRACE", "dflt") is not None or True
+
+
+def test_fault_registry_covers_compiled_in_points():
+    from ceph_tpu.runtime import faults
+
+    assert set(faults.FAULT_POINTS) == {
+        "init", "map_batch", "stage", "stage_end",
+    }
+
+
+# -- runner + reporters -----------------------------------------------------
+
+def test_run_unknown_pass_raises():
+    with pytest.raises(KeyError, match="no-such-pass"):
+        run(select=["no-such-pass"])
+
+
+def test_json_report_shape(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import numpy as np\n"
+                   "with obs.span('pipeline.map_block'):\n"
+                   "    a = np.asarray(x)\n")
+    violations, report = run(select=["host-sync"], paths=[bad])
+    assert report["tool"] == "graftlint"
+    assert report["passes"] == ["host-sync"]
+    assert report["count"] == len(violations) == 1
+    (rec,) = report["violations"]
+    assert rec["pass"] == "host-sync" and rec["line"] == 3
+
+
+def test_unparseable_file_is_a_violation(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    violations, report = run(select=["no-print"], paths=[bad])
+    assert report["count"] == 1
+    assert violations[0].pass_name == "parse"
+
+
+# -- the repo itself is clean (the actual contract gate) --------------------
+
+def test_repo_scans_clean_all_passes():
+    violations, report = run()
+    assert report["passes"] == sorted(PASSES)
+    assert len(report["passes"]) >= 7
+    assert violations == [], "\n".join(v.format() for v in violations)
+
+
+@pytest.mark.slow
+def test_cli_json_whole_repo():
+    """The CLI entry bench.py --selftest shells out to: exit 0, JSON on
+    stdout, all passes, zero violations, well under the 30 s budget."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    rep = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rep["count"] == 0 and rep["violations"] == []
+    assert set(rep["passes"]) == set(PASSES)
+    assert rep["elapsed_s"] < 30, rep["elapsed_s"]
+    assert "clean" in proc.stderr
+
+
+def test_cli_list_and_bad_select():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--list"],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert out.returncode == 0
+    for name in PASSES:
+        assert name in out.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--select", "nope"],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert bad.returncode == 2 and "unknown pass" in bad.stderr

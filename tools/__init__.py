@@ -1,0 +1,4 @@
+"""Repo tooling package (`python -m tools.graftlint`, corpus/probe
+scripts).  The lint scripts double as standalone files — see the shims
+`check_no_print.py` / `check_no_host_sync.py` — so nothing in here may
+import jax or the ceph_tpu runtime."""

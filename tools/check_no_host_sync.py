@@ -1,123 +1,51 @@
-"""Lint: no host syncs inside the dispatch spans.
+"""Lint shim: no host syncs inside the dispatch spans.
 
-`pipeline.map_block`, `pipeline.rescue` and the EC engine's
-`ec.gf_dispatch` spans time DISPATCH — the enqueue of already-compiled
-work onto the device.  A `np.asarray(...)`, `.item()` or `float(...)`
-on a traced value inside one of those bodies blocks on the device and
-silently turns the span into a transfer measurement (the exact bug
-that made r05's per-block numbers fetch-bound, and that made the EC
-engine's old dispatch span time the d2h fetch of every host-facing
-matmul); the fetch belongs in `pipeline.fetch` / `ec.gf_fetch` (or
-between the spans, as the unresolved-flag read in
-PoolMapper._map_block_inner does).
+The real check is graftlint's `host-sync` pass (tools/graftlint/passes/
+host_sync.py), which hardened this file's original one-off walker: the
+dispatch-span set now comes from the `obs.spans.DISPATCH_SPANS` registry
+instead of a hardcoded tuple, numpy/jax references are alias-resolved
+(`from numpy import asarray as aa` counts), `int()`/`bool()`/
+`jax.device_get()`/`.block_until_ready()` joined the sync list, and a
+`with` carrying several span items reports all of them, not just the
+first.  This file keeps the historical entry points alive —
+`python tools/check_no_host_sync.py` and `from check_no_host_sync
+import check_file` (tests/test_obs.py) — by delegating to the shared
+engine.
 
-This lint walks the AST of every hot-path module plus bench.py and
-flags, inside any `with obs.span("pipeline.map_block"...)` /
-`obs.span("pipeline.rescue"...)` / `obs.span("ec.gf_dispatch"...)`
-body:
-
-    np.asarray(...) / np.array(...) / numpy.asarray(...)
-    <expr>.item()
-    float(...)
-
-The check is syntactic — it cannot prove an operand is traced — so
-host-only work belongs *outside* the span (hoist it; every current call
-site needs nothing inside but dispatches and device-side scatters).
-
-Runnable standalone (exit 1 on violations) and from tests:
-
-    python tools/check_no_host_sync.py
-    from check_no_host_sync import find_violations
+    python tools/check_no_host_sync.py      # exit 1 on violations
+    python -m tools.graftlint --select host-sync
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:  # script/flat-import mode: tools/ is path[0]
+    sys.path.insert(0, str(REPO))
 
-SPAN_NAMES = ("pipeline.map_block", "pipeline.rescue", "ec.gf_dispatch")
+from tools.graftlint import PASSES, Context  # noqa: E402
 
-SCAN = (
-    "ceph_tpu",
-    "bench.py",
-    "__graft_entry__.py",
-)
-
-
-def _span_name(item: ast.withitem) -> str | None:
-    """The span name if this with-item is obs.span("...")/span("...")."""
-    c = item.context_expr
-    if not isinstance(c, ast.Call) or not c.args:
-        return None
-    f = c.func
-    name = f.attr if isinstance(f, ast.Attribute) else (
-        f.id if isinstance(f, ast.Name) else None
-    )
-    if name != "span":
-        return None
-    a0 = c.args[0]
-    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
-        return a0.value
-    return None
-
-
-def _sync_call(node: ast.Call) -> str | None:
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        if f.attr == "item" and not node.args:
-            return ".item()"
-        if (
-            f.attr in ("asarray", "array")
-            and isinstance(f.value, ast.Name)
-            and f.value.id in ("np", "numpy")
-        ):
-            return f"{f.value.id}.{f.attr}()"
-    elif isinstance(f, ast.Name) and f.id == "float":
-        return "float()"
-    return None
+PASS = "host-sync"
 
 
 def check_file(path: Path) -> list[str]:
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: unparseable: {e.msg}"]
-    rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
-    out: list[str] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.With, ast.AsyncWith)):
-            continue
-        spans = [
-            s for s in (_span_name(i) for i in node.items)
-            if s in SPAN_NAMES
-        ]
-        if not spans:
-            continue
-        for sub in node.body:
-            for call in ast.walk(sub):
-                if isinstance(call, ast.Call):
-                    what = _sync_call(call)
-                    if what:
-                        out.append(
-                            f"{rel}:{call.lineno}: {what} inside a "
-                            f"{spans[0]} span (host sync; fetch belongs "
-                            "in pipeline.fetch)"
-                        )
-    return out
+    from tools.graftlint import Module
+
+    ctx = Context(paths=[], include_tests=False)
+    module = Module(Path(path), REPO)
+    if module.parse_error is not None:
+        line, msg = module.parse_error
+        return [f"{module.rel}:{line}: unparseable: {msg}"]
+    return [v.format() for v in PASSES[PASS].check_module(module, ctx)]
 
 
 def find_violations(root: Path = REPO) -> list[str]:
-    out: list[str] = []
-    for entry in SCAN:
-        p = root / entry
-        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
-        for py in files:
-            if py.exists():
-                out.extend(check_file(py))
-    return out
+    from tools.graftlint import run
+
+    violations, _ = run(select=[PASS], root=Path(root))
+    return [v.format() for v in violations]
 
 
 def main() -> int:

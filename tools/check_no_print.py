@@ -1,76 +1,44 @@
-"""Lint: hot-path modules never print() to stdout.
+"""Lint shim: hot-path modules never print() to stdout.
 
-The reference routes all daemon output through dout/derr and the perf
-registry — stdout belongs to the CLI tools' machine-readable output
-(crushtool -d, perf dump JSON).  A stray debugging `print()` in the
-mapping/EC/balancer hot paths corrupts that contract (and is invisible
-in a killed bench run, unlike a counter).  This lint walks the AST of
-every module under the hot-path packages and flags:
+The real check is graftlint's `no-print` pass (tools/graftlint/passes/
+no_print.py); this file keeps the historical entry points alive —
+`python tools/check_no_print.py` and `from check_no_print import
+check_file` (tests/test_obs.py) — by delegating to the shared engine.
 
-    print(...)                  # no file= -> stdout
-    print(..., file=sys.stdout) # explicit stdout
-
-`print(..., file=w)` with any other stream is allowed — that is how the
-tester renders `--show-mappings` output to a caller-chosen stream.
-
-Runnable standalone (exit 1 on violations) and from tests:
-
-    python tools/check_no_print.py
-    from check_no_print import find_violations
+    python tools/check_no_print.py          # exit 1 on violations
+    python -m tools.graftlint --select no-print
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:  # script/flat-import mode: tools/ is path[0]
+    sys.path.insert(0, str(REPO))
 
-HOT_PACKAGES = (
-    "ceph_tpu/crush",
-    "ceph_tpu/osd",
-    "ceph_tpu/ec",
-    "ceph_tpu/balancer",
-    "ceph_tpu/mgr",
-)
+from tools.graftlint import PASSES, Context  # noqa: E402
 
-
-def _is_stdout_print(node: ast.Call) -> bool:
-    if not (isinstance(node.func, ast.Name) and node.func.id == "print"):
-        return False
-    for kw in node.keywords:
-        if kw.arg == "file":
-            v = kw.value
-            return (
-                isinstance(v, ast.Attribute)
-                and v.attr == "stdout"
-                and isinstance(v.value, ast.Name)
-                and v.value.id == "sys"
-            )
-    return True  # bare print() -> stdout
+PASS = "no-print"
 
 
 def check_file(path: Path) -> list[str]:
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: unparseable: {e.msg}"]
-    rel = path.relative_to(REPO) if path.is_relative_to(REPO) else path
-    return [
-        f"{rel}:{node.lineno}: print() to stdout "
-        "(route through ceph_tpu.utils.dout or a perf counter)"
-        for node in ast.walk(tree)
-        if isinstance(node, ast.Call) and _is_stdout_print(node)
-    ]
+    from tools.graftlint import Module
+
+    ctx = Context(paths=[], include_tests=False)
+    module = Module(Path(path), REPO)
+    if module.parse_error is not None:
+        line, msg = module.parse_error
+        return [f"{module.rel}:{line}: unparseable: {msg}"]
+    return [v.format() for v in PASSES[PASS].check_module(module, ctx)]
 
 
 def find_violations(root: Path = REPO) -> list[str]:
-    out: list[str] = []
-    for pkg in HOT_PACKAGES:
-        for py in sorted((root / pkg).rglob("*.py")):
-            out.extend(check_file(py))
-    return out
+    from tools.graftlint import run
+
+    violations, _ = run(select=[PASS], root=Path(root))
+    return [v.format() for v in violations]
 
 
 def main() -> int:

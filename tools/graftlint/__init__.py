@@ -1,0 +1,33 @@
+"""graftlint — unified contract-checking static analysis for the
+trace-once stack.
+
+    python -m tools.graftlint [--json] [--select pass1,pass2] [--list]
+
+One AST/alias-aware engine (`engine.py`), a pass registry
+(`passes/`), per-line `# graftlint: disable=<pass>` suppressions, and
+human/JSON reporters.  The passes machine-check the conventions PRs 1-6
+established by review: dispatch spans never host-sync, jitted kernels
+never bake per-map data into traces, counter updates match declares,
+CEPH_TPU knobs are registered and documented, span names exist in the
+obs registry, fault points are declared and test-covered.
+
+Library surface (used by tests, bench.py --selftest, and the
+`check_no_print.py` / `check_no_host_sync.py` compatibility shims):
+
+    from tools.graftlint import run, PASSES, Module, Context
+    violations, report = run()                    # all passes, whole repo
+    violations, report = run(select=["host-sync"])
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    PASSES,
+    Context,
+    Module,
+    Pass,
+    Violation,
+    human_report,
+    iter_files,
+    register,
+    run,
+)
+from tools.graftlint import passes  # noqa: E402,F401  (registers passes)

@@ -1,0 +1,63 @@
+"""CLI: python -m tools.graftlint [--json] [--select a,b] [--list].
+
+Exit status: 0 clean, 1 violations found, 2 bad usage.  Human output
+goes to stderr (like the lints this framework absorbed); --json writes
+the machine-readable report to stdout (embedded by bench.py --selftest
+into the BENCH record).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tools.graftlint import PASSES, human_report, run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="contract-checking static analysis for this repo",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--select", metavar="PASS[,PASS...]",
+                    help="run only these passes (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the README CEPH_TPU_* knob table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(PASSES):
+            print(f"{name:16} {PASSES[name].doc}")
+        return 0
+    if args.knob_table:
+        # late import: keeps lint runs free of the ceph_tpu import graph
+        from ceph_tpu.utils.knobs import render_table
+
+        print(render_table(), end="")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select else None
+    )
+    t0 = time.perf_counter()
+    try:
+        violations, report = run(select=select)
+    except KeyError as e:
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    if args.json:
+        print(json.dumps(report))
+    print(human_report(violations, report["passes"]), file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,328 @@
+"""graftlint core: module model, alias resolution, registries, runner.
+
+One shared AST walker feeds every pass (the old one-off lints each
+re-parsed the tree and re-invented alias handling, and each had blind
+spots the other had already fixed).  The engine:
+
+- discovers and parses every module once (`Context.modules`), plus the
+  test tree (`Context.test_modules`) for passes that cross-check tests;
+- resolves import aliases (`import numpy as np`, `from numpy import
+  asarray as aa`, `from ceph_tpu.runtime import faults`) to canonical
+  dotted names so passes match semantics, not spellings;
+- extracts the three contract registries **statically** (span registry,
+  env-knob registry, fault-point registry) — linting never imports the
+  tree, so a syntax error or import-time side effect cannot wedge it;
+- applies per-line `# graftlint: disable=<pass>[,<pass>...]` (or
+  `disable=all`) suppressions against the reported violation line;
+- renders human (stderr-style lines) and JSON reports.
+
+Passes self-register via `@register`; `run()` executes a selection and
+returns sorted, suppression-filtered violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+# the shared module walker's scan set: every hot-path package plus the
+# entry points and the tooling itself (tools/ is held to its own lints)
+SCAN = ("ceph_tpu", "bench.py", "__graft_entry__.py", "tools")
+TEST_DIR = "tests"
+
+SPAN_REGISTRY = "ceph_tpu/obs/spans.py"
+KNOB_REGISTRY = "ceph_tpu/utils/knobs.py"
+FAULT_REGISTRY = "ceph_tpu/runtime/faults.py"
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,-]+)")
+
+
+@dataclass
+class Violation:
+    path: str  # repo-relative where possible
+    line: int
+    pass_name: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "pass": self.pass_name,
+            "message": self.message,
+        }
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """The dotted source spelling of a Name/Attribute chain
+    (`jax.numpy.asarray` -> "jax.numpy.asarray"), None for anything
+    dynamic (subscripts, calls)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class Module:
+    """One parsed file: AST + alias maps + suppression lines."""
+
+    def __init__(self, path: Path, root: Path = REPO):
+        self.path = Path(path)
+        self.rel = (
+            str(self.path.relative_to(root))
+            if self.path.is_relative_to(root) else str(self.path)
+        )
+        src = self.path.read_text()
+        self.parse_error: tuple[int, str] | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(src, filename=self.rel)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = (e.lineno or 0, e.msg or "syntax error")
+        self.suppressions: dict[int, set[str]] = {}
+        for i, line in enumerate(src.splitlines(), 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions[i] = {
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                }
+        # import alias maps
+        self.mod_alias: dict[str, str] = {}   # local name -> module dotted
+        self.from_alias: dict[str, str] = {}  # local name -> module.attr
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        self.mod_alias[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0]
+                        )
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        if a.name == "*":
+                            continue
+                        self.from_alias[a.asname or a.name] = (
+                            f"{node.module}.{a.name}"
+                        )
+        # "from ceph_tpu.runtime import faults" binds a *module*: route
+        # it through mod_alias so canonical() expands the full path
+        for local, target in list(self.from_alias.items()):
+            head = target.rsplit(".", 1)[-1]
+            if head == local and target.count(".") >= 1:
+                # keep in from_alias too; canonical() tries both
+                self.mod_alias.setdefault(local, target)
+
+    def canonical(self, node: ast.AST) -> str | None:
+        """Alias-resolved dotted name of a Name/Attribute chain:
+        `np.asarray` -> "numpy.asarray", `aa` (from numpy import asarray
+        as aa) -> "numpy.asarray", `environ.get` (from os import
+        environ) -> "os.environ.get"."""
+        d = dotted_name(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        if head in self.from_alias:
+            base = self.from_alias[head]
+        elif head in self.mod_alias:
+            base = self.mod_alias[head]
+        else:
+            base = head
+        return f"{base}.{rest}" if rest else base
+
+    def suppressed(self, line: int, pass_name: str) -> bool:
+        tags = self.suppressions.get(line)
+        return bool(tags) and (pass_name in tags or "all" in tags)
+
+    def filter(self, violations: list["Violation"]) -> list["Violation"]:
+        """Drop violations a `# graftlint: disable=` line suppresses —
+        the single place suppression is applied for per-module entry
+        points (engine.run() applies the same filter for full runs)."""
+        return [
+            v for v in violations if not self.suppressed(v.line, v.pass_name)
+        ]
+
+
+def iter_files(root: Path = REPO, scan=SCAN) -> list[Path]:
+    out: list[Path] = []
+    for entry in scan:
+        p = root / entry
+        if p.is_dir():
+            out.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.exists():
+            out.append(p)
+    return out
+
+
+def _literal_assign(tree: ast.Module, name: str):
+    """The ast node of a module-level `NAME = <literal>` assignment."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if (isinstance(node.target, ast.Name)
+                    and node.target.id == name and node.value is not None):
+                return node.value
+    return None
+
+
+def _load_registry(path: Path, name: str, default):
+    """literal_eval a module-level constant out of a registry module,
+    plus per-key line numbers for dict registries."""
+    if not path.exists():
+        return default, {}
+    tree = ast.parse(path.read_text(), filename=str(path))
+    node = _literal_assign(tree, name)
+    if node is None:
+        return default, {}
+    try:
+        value = ast.literal_eval(node)
+    except ValueError:
+        return default, {}
+    lines: dict[str, int] = {}
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                lines[k.value] = k.lineno
+    return value, lines
+
+
+class Context:
+    """Everything a pass needs: parsed modules, registries, a sink."""
+
+    def __init__(self, root: Path = REPO, paths: list[Path] | None = None,
+                 include_tests: bool = True):
+        self.root = Path(root)
+        files = iter_files(self.root) if paths is None else [
+            Path(p) for p in paths
+        ]
+        self.modules = [Module(p, self.root) for p in files]
+        self._include_tests = include_tests
+        self._test_modules: list[Module] | None = None
+        self.violations: list[Violation] = []
+        # contract registries, extracted without importing the tree
+        self.spans, _ = _load_registry(self.root / SPAN_REGISTRY, "SPANS", {})
+        self.instants, _ = _load_registry(
+            self.root / SPAN_REGISTRY, "INSTANTS", {})
+        self.trace_counters, _ = _load_registry(
+            self.root / SPAN_REGISTRY, "COUNTERS", {})
+        self.span_prefixes, _ = _load_registry(
+            self.root / SPAN_REGISTRY, "PREFIXES", ())
+        self.dispatch_spans, _ = _load_registry(
+            self.root / SPAN_REGISTRY, "DISPATCH_SPANS", ())
+        self.knobs, self.knob_lines = _load_registry(
+            self.root / KNOB_REGISTRY, "KNOBS", {})
+        self.fault_points, self.fault_lines = _load_registry(
+            self.root / FAULT_REGISTRY, "FAULT_POINTS", {})
+
+    @property
+    def test_modules(self) -> list[Module]:
+        """tests/ parsed on first access — only the fault-point pass
+        consumes these, so single-pass runs (the shims) skip the work."""
+        if self._test_modules is None:
+            self._test_modules = []
+            if self._include_tests and (self.root / TEST_DIR).is_dir():
+                self._test_modules = [
+                    Module(p, self.root)
+                    for p in sorted((self.root / TEST_DIR).rglob("*.py"))
+                    if "__pycache__" not in p.parts
+                ]
+        return self._test_modules
+
+    def report(self, module: Module, node, pass_name: str,
+               message: str) -> None:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 0)
+        self.violations.append(Violation(module.rel, line, pass_name, message))
+
+
+class Pass:
+    """Base class; subclasses set `name`/`doc` and implement run()."""
+
+    name = "?"
+    doc = ""
+
+    def run(self, ctx: Context) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def check_module(self, module: Module, ctx: Context) -> list[Violation]:
+        """Run this pass against one module only (shim/fixture entry):
+        default routes through run() on a throwaway sink."""
+        sub = object.__new__(Context)
+        sub.__dict__.update(ctx.__dict__)
+        sub.modules = [module]
+        sub.violations = []
+        self.run(sub)
+        return [
+            v for v in sub.violations
+            if not module.suppressed(v.line, v.pass_name)
+        ]
+
+
+PASSES: dict[str, Pass] = {}
+
+
+def register(cls):
+    PASSES[cls.name] = cls()
+    return cls
+
+
+def run(select: list[str] | None = None, root: Path = REPO,
+        paths: list[Path] | None = None) -> tuple[list[Violation], dict]:
+    """Execute the selected passes; returns (violations, report_dict).
+
+    Unparseable scanned files are themselves violations (every pass is
+    blind to a file it cannot parse, so that must fail loudly)."""
+    from tools.graftlint import passes as _passes  # noqa: F401  (registers)
+
+    names = sorted(PASSES) if select is None else list(select)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise KeyError(f"unknown pass(es): {', '.join(unknown)}; "
+                       f"available: {', '.join(sorted(PASSES))}")
+    ctx = Context(root=root, paths=paths)
+    for m in ctx.modules:
+        if m.parse_error is not None:
+            ctx.violations.append(Violation(
+                m.rel, m.parse_error[0], "parse",
+                f"unparseable: {m.parse_error[1]}",
+            ))
+    by_path = {m.rel: m for m in ctx.modules}
+    for n in names:
+        PASSES[n].run(ctx)
+    out = [
+        v for v in ctx.violations
+        if v.path not in by_path
+        or not by_path[v.path].suppressed(v.line, v.pass_name)
+    ]
+    out.sort(key=lambda v: (v.path, v.line, v.pass_name))
+    report = {
+        "tool": "graftlint",
+        "passes": names,
+        "files_scanned": len(ctx.modules),
+        "count": len(out),
+        "violations": [v.as_json() for v in out],
+    }
+    return out, report
+
+
+def human_report(violations: list[Violation], names: list[str]) -> str:
+    lines = [v.format() for v in violations]
+    lines.append(
+        f"graftlint [{','.join(names)}]: "
+        + (f"{len(violations)} violation(s)" if violations else "clean")
+    )
+    return "\n".join(lines)

@@ -1,0 +1,17 @@
+"""Pass registry: importing this package registers every pass.
+
+Adding a pass: create a module here, subclass `engine.Pass`, decorate
+with `@engine.register`, and import the module below.  Give it a
+kebab-case `name` (that is the `--select` and `# graftlint:
+disable=<name>` token) and a one-line `doc` (shown by `--list`).
+"""
+
+from tools.graftlint.passes import (  # noqa: F401
+    counter_decl,
+    env_knob,
+    fault_point,
+    host_sync,
+    no_print,
+    span_name,
+    trace_constant,
+)

@@ -1,0 +1,169 @@
+"""counter-decl: every perf-counter update key matches a declaration.
+
+The perf registry raises `UndeclaredCounterError` at runtime on an
+update to an undeclared key — but only when that code path actually
+runs, which for rarely-taken branches (rescue paths, failure fallbacks)
+means the typo ships and the counter silently never exists until a
+production run dies on it.  This pass matches update keys against
+declares statically, across the whole scanned tree.
+
+Resolution is alias-aware and group-scoped:
+
+- `L = obs.logger_for("pipeline")` binds L to group "pipeline" (module
+  or function scope; `logger_for` bare or attribute-qualified);
+- a module function whose body returns such a logger propagates the
+  group to `_counters().inc(...)`-style call sites;
+- declares (`add_u64` / `add_avg` / `add_time_avg` / `add_histogram`)
+  with literal keys are collected per group ACROSS modules — bench.py
+  updating "pipeline" keys declared in pipeline_jax.py is fine;
+- f-string declares contribute their constant tail as a dynamic-suffix
+  pattern (`JitAccount` declares `f"{key}_compiles"` etc.), matched by
+  `endswith` for updates whose exact key cannot be known statically;
+- updates (`inc` / `observe` / `time` / `set`) with literal keys must
+  hit a declared key of their group; unresolvable receivers fall back
+  to the union of all declared keys (`set` requires a resolved
+  receiver — too many non-logger `.set()` calls exist).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+DECLARES = ("add_u64", "add_avg", "add_time_avg", "add_histogram")
+UPDATES = ("inc", "observe", "time", "set")
+
+
+def _logger_for_group(node: ast.AST, module: Module) -> str | None:
+    """The group name if node is `[obs.]logger_for("g")`."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    c = module.canonical(node.func)
+    if c is None or not (c == "logger_for" or c.endswith(".logger_for")):
+        return None
+    a0 = node.args[0]
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value
+    return None
+
+
+def _module_logger_maps(module: Module):
+    """(name->group for logger variables, funcname->group for functions
+    returning a logger).  A name bound to two different groups resolves
+    to None (ambiguous)."""
+    names: dict[str, str | None] = {}
+    funcs: dict[str, str] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign):
+            g = _logger_for_group(node.value, module)
+            if g is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        prev = names.get(t.id)
+                        names[t.id] = g if prev in (None, g) else None
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    g = _logger_for_group(sub.value, module)
+                    if g is None and isinstance(sub.value, ast.Name):
+                        local = {}
+                        for s2 in ast.walk(node):
+                            if isinstance(s2, ast.Assign):
+                                lg = _logger_for_group(s2.value, module)
+                                if lg is not None:
+                                    for t in s2.targets:
+                                        if isinstance(t, ast.Name):
+                                            local[t.id] = lg
+                        g = local.get(sub.value.id)
+                    if g is not None:
+                        funcs[node.name] = g
+    return names, funcs
+
+
+def _receiver_group(recv: ast.AST, module: Module, names, funcs):
+    if isinstance(recv, ast.Name):
+        return names.get(recv.id)
+    if isinstance(recv, ast.Call):
+        g = _logger_for_group(recv, module)
+        if g is not None:
+            return g
+        if isinstance(recv.func, ast.Name):
+            return funcs.get(recv.func.id)
+    return None
+
+
+def _fstring_tail(node: ast.JoinedStr) -> str | None:
+    """The trailing constant of an f-string key (f"{key}_compiles" ->
+    "_compiles"), None when it ends dynamically."""
+    if node.values and isinstance(node.values[-1], ast.Constant):
+        v = node.values[-1].value
+        if isinstance(v, str) and v:
+            return v
+    return None
+
+
+@register
+class CounterDeclPass(Pass):
+    name = "counter-decl"
+    doc = "perf-counter update keys statically match a declaration"
+
+    def run(self, ctx: Context) -> None:
+        declared: dict[str, set[str]] = defaultdict(set)
+        wildcard: set[str] = set()   # declares on unresolvable receivers
+        suffixes: set[str] = set()   # dynamic-declare key tails
+        sites = []  # (module, call, group, method, key)
+
+        for m in ctx.modules:
+            if m.tree is None:
+                continue
+            names, funcs = _module_logger_maps(m)
+            for node in ast.walk(m.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                meth = node.func.attr
+                if meth not in DECLARES and meth not in UPDATES:
+                    continue
+                if not node.args:
+                    continue
+                group = _receiver_group(node.func.value, m, names, funcs)
+                a0 = node.args[0]
+                if meth in DECLARES:
+                    if isinstance(a0, ast.Constant) and isinstance(
+                            a0.value, str):
+                        if group is not None:
+                            declared[group].add(a0.value)
+                        else:
+                            wildcard.add(a0.value)
+                    elif isinstance(a0, ast.JoinedStr):
+                        tail = _fstring_tail(a0)
+                        if tail:
+                            suffixes.add(tail)
+                elif isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, str):
+                    sites.append((m, node, group, meth, a0.value))
+
+        every = wildcard.union(*declared.values()) if declared else wildcard
+        for m, node, group, meth, key in sites:
+            if group is not None:
+                known = declared.get(group, set()) | wildcard
+                scope = f"group '{group}'"
+            elif meth == "set":
+                continue  # unresolved .set("...") receivers: not loggers
+            else:
+                known = every
+                scope = "any scanned group"
+            if key in known:
+                continue
+            if any(key.endswith(s) for s in suffixes):
+                continue  # JitAccount-style dynamically declared family
+            ctx.violations.append(Violation(
+                m.rel, node.lineno, self.name,
+                f"counter update {meth}({key!r}) has no declaration in "
+                f"{scope} (UndeclaredCounterError at runtime; declare "
+                "with add_u64/add_avg/add_time_avg/add_histogram)",
+            ))

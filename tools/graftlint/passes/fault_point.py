@@ -1,0 +1,123 @@
+"""fault-point: fault points are declared, and every one is tested.
+
+`runtime/faults.py` declares the compiled-in fault points
+(`FAULT_POINTS`).  Two contract directions:
+
+- every production `faults.check("<point>", ...)` site and every
+  fault-spec string baked into scanned code (bench selftest env, CLI
+  defaults) must use a declared base point — an undeclared point can
+  never be armed by a documented spec;
+- every declared point must be referenced by at least one test
+  (`faults.arm/check/configure` literals or CEPH_TPU_FAULTS-style spec
+  strings in tests/) — an untested fault point is a retry/degradation
+  branch nobody runs until a real device wedges.
+
+Tests may arm ad-hoc points (qualifier-mismatch probes, "anything");
+only production call sites are held to the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+FAULTS_MODULE = "ceph_tpu/runtime/faults.py"
+
+# one item of a CEPH_TPU_FAULTS spec: point[.qual]=action[:arg][ xN]
+_SPEC_ITEM = re.compile(
+    r"^([A-Za-z_]\w*)(\.[\w.-]+)?="
+    r"(hang|stall|fail|lost|exit|overrun)(:[^,\s]*)?(\s*x\d+)?$"
+)
+
+
+def _spec_bases(s: str) -> list[str]:
+    """Base points of a fault-spec-looking string ("a.b=fail:x x2,c=hang"
+    -> ["a", "c"]); [] when the string is not spec-shaped."""
+    out = []
+    for item in s.split(","):
+        m = _SPEC_ITEM.match(item.strip())
+        if not m:
+            return []
+        out.append(m.group(1))
+    return out
+
+
+def _check_sites(module: Module):
+    """Yield (base_point, node) for faults.check/arm literals."""
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        c = module.canonical(node.func)
+        if c is None:
+            continue
+        if c.endswith("faults.check") or c.endswith("faults.arm") or (
+                "." not in c and c in ("check", "arm")
+                and module.from_alias.get(c, "").endswith(f"faults.{c}")):
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                yield a0.value.split(".")[0], node
+
+
+@register
+class FaultPointPass(Pass):
+    name = "fault-point"
+    doc = "fault points declared in FAULT_POINTS; each covered by a test"
+
+    def run(self, ctx: Context) -> None:
+        if not ctx.fault_points:
+            return
+        # (a) production sites use declared bases
+        for m in ctx.modules:
+            if m.tree is None:
+                continue
+            if m.rel.endswith("runtime/faults.py"):
+                continue  # hosts the machinery (and doc examples)
+            for base, node in _check_sites(m):
+                if base not in ctx.fault_points:
+                    ctx.violations.append(Violation(
+                        m.rel, node.lineno, self.name,
+                        f"fault point base {base!r} is not declared in "
+                        "runtime/faults.py FAULT_POINTS",
+                    ))
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str):
+                    for base in _spec_bases(node.value):
+                        if base not in ctx.fault_points:
+                            ctx.violations.append(Violation(
+                                m.rel, node.lineno, self.name,
+                                f"fault spec {node.value!r} uses "
+                                f"undeclared point base {base!r}",
+                            ))
+
+        # (b) every declared point is exercised by at least one test
+        if not ctx.test_modules:
+            return
+        referenced: set[str] = set()
+        for tm in ctx.test_modules:
+            if tm.tree is None:
+                continue
+            for base, _ in _check_sites(tm):
+                referenced.add(base)
+            for node in ast.walk(tm.tree):
+                if isinstance(node, ast.Call) and node.args:
+                    c = tm.canonical(node.func)
+                    if c is not None and c.endswith("faults.configure"):
+                        a0 = node.args[0]
+                        if isinstance(a0, ast.Constant) and isinstance(
+                                a0.value, str):
+                            referenced.update(_spec_bases(a0.value))
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str):
+                    referenced.update(_spec_bases(node.value))
+        for point in sorted(ctx.fault_points):
+            if point not in referenced:
+                ctx.violations.append(Violation(
+                    FAULTS_MODULE, ctx.fault_lines.get(point, 1), self.name,
+                    f"declared fault point {point!r} is referenced by no "
+                    "test — its retry/degradation branch is unexercised",
+                ))

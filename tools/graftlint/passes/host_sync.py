@@ -1,0 +1,108 @@
+"""host-sync: no host synchronization inside dispatch spans.
+
+The dispatch spans (`obs.spans.DISPATCH_SPANS`: pipeline.map_block,
+pipeline.rescue, ec.gf_dispatch) time the ENQUEUE of already-compiled
+device work.  A `np.asarray(...)`, `.item()`, `float(...)`, `int(...)`,
+`bool(...)`, `jax.device_get(...)` or `.block_until_ready()` on a traced
+value inside one of those bodies blocks on the device and silently turns
+the span into a transfer measurement (the exact bug that made r05's
+per-block numbers fetch-bound).  Fetches belong in `pipeline.fetch` /
+`ec.gf_fetch`, or between the spans.
+
+The check is syntactic — it cannot prove an operand is traced — so
+host-only scalar work also belongs *outside* the span (hoist it; every
+current call site needs nothing inside but dispatches and device-side
+scatters).  The span set comes from the registry, not a hardcoded tuple,
+and numpy/jax references are alias-resolved (`import numpy as anything`,
+`from numpy import asarray as aa`); every matching with-item is named in
+the report, not just the first.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+_NUMPY_SYNCS = ("asarray", "array")
+_BARE_SYNCS = ("float", "int", "bool")
+
+
+def span_name(item: ast.withitem, module: Module) -> str | None:
+    """The span name if this with-item is obs.span("...")/span("...")."""
+    c = item.context_expr
+    if not isinstance(c, ast.Call) or not c.args:
+        return None
+    f = c.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name != "span":
+        return None
+    a0 = c.args[0]
+    if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+        return a0.value
+    return None
+
+
+def sync_call(node: ast.Call, module: Module) -> str | None:
+    """Human name of the host sync this call performs, else None."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "item" and not node.args:
+            return ".item()"
+        if f.attr == "block_until_ready" and not node.args:
+            return ".block_until_ready()"
+    c = module.canonical(f)
+    if c is not None:
+        mod, _, attr = c.rpartition(".")
+        if mod == "numpy" and attr in _NUMPY_SYNCS:
+            return f"numpy.{attr}()"
+        if mod == "jax" and attr == "device_get":
+            return "jax.device_get()"
+    if isinstance(f, ast.Name) and f.id in _BARE_SYNCS:
+        # a from-import may shadow the builtin; canonical() already
+        # returned the import target above for those
+        if f.id not in module.from_alias and f.id not in module.mod_alias:
+            return f"{f.id}()"
+    return None
+
+
+@register
+class HostSyncPass(Pass):
+    name = "host-sync"
+    doc = "no host syncs inside dispatch spans (registry-sourced set)"
+
+    def run(self, ctx: Context) -> None:
+        for m in ctx.modules:
+            ctx.violations.extend(self.check_module(m, ctx))
+
+    def check_module(self, module: Module, ctx: Context) -> list[Violation]:
+        if module.tree is None:
+            return []
+        dispatch = set(ctx.dispatch_spans)
+        out: list[Violation] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            spans = [
+                s for s in (span_name(i, module) for i in node.items)
+                if s in dispatch
+            ]
+            if not spans:
+                continue
+            where = " + ".join(spans)
+            for sub in node.body:
+                for call in ast.walk(sub):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    what = sync_call(call, module)
+                    if what:
+                        out.append(Violation(
+                            module.rel, call.lineno, self.name,
+                            f"{what} inside a {where} span (host sync; "
+                            "hoist it, or fetch in pipeline.fetch)",
+                        ))
+        return module.filter(out)

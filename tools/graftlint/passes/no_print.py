@@ -1,0 +1,60 @@
+"""no-print: hot-path modules never print() to stdout.
+
+The reference routes all daemon output through dout/derr and the perf
+registry — stdout belongs to the CLI tools' machine-readable output
+(crushtool -d, perf dump JSON).  A stray debugging `print()` in the
+mapping/EC/balancer hot paths corrupts that contract (and is invisible
+in a killed bench run, unlike a counter).  `print(..., file=w)` with any
+stream other than sys.stdout is allowed — that is how the tester renders
+`--show-mappings` output to a caller-chosen stream.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+HOT_PACKAGES = (
+    "ceph_tpu/crush",
+    "ceph_tpu/osd",
+    "ceph_tpu/ec",
+    "ceph_tpu/balancer",
+    "ceph_tpu/mgr",
+)
+
+_MSG = ("print() to stdout (route through ceph_tpu.utils.dout or a "
+        "perf counter)")
+
+
+def _is_stdout_print(node: ast.Call, module: Module) -> bool:
+    if not (isinstance(node.func, ast.Name) and node.func.id == "print"):
+        return False
+    for kw in node.keywords:
+        if kw.arg == "file":
+            return module.canonical(kw.value) == "sys.stdout"
+    return True  # bare print() -> stdout
+
+
+@register
+class NoPrintPass(Pass):
+    name = "no-print"
+    doc = "hot-path modules never print() to stdout"
+
+    def run(self, ctx: Context) -> None:
+        for m in ctx.modules:
+            if any(m.rel.startswith(p) for p in HOT_PACKAGES):
+                for v in self.check_module(m, ctx):
+                    ctx.violations.append(v)
+
+    def check_module(self, module: Module, ctx: Context) -> list[Violation]:
+        """One file, scope-free (the shim and fixtures enter here)."""
+        if module.tree is None:
+            return []
+        return module.filter([
+            Violation(module.rel, node.lineno, self.name, _MSG)
+            for node in ast.walk(module.tree)
+            if isinstance(node, ast.Call) and _is_stdout_print(node, module)
+        ])

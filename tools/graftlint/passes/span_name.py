@@ -1,0 +1,97 @@
+"""span-name: trace event names exist in the obs span registry.
+
+A typo'd span name fails nothing at runtime — the events record under
+the wrong track and every Perfetto query / trace-driven analysis
+silently misses them.  `ceph_tpu/obs/spans.py` is the single registry;
+this pass checks every literal `span(...)` / `instant(...)` /
+`obs.counter(...)` name (and `JitAccount(span=...)` base names) against
+it.  Dynamically built names must carry a registered static prefix
+(`f"stage.{name}"` -> "stage."); f-strings with no static head
+(JitAccount's `f"{group}.{key}.{phase}"`) are exempt by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+
+def _fstring_head(node: ast.JoinedStr) -> str:
+    head = ""
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            head += v.value
+        else:
+            break
+    return head
+
+
+def _recv_is_obs(func: ast.Attribute, module: Module) -> bool:
+    c = module.canonical(func.value)
+    if c is None:
+        return False
+    tail = c.rsplit(".", 1)[-1]
+    return tail in ("obs", "trace")
+
+
+@register
+class SpanNamePass(Pass):
+    name = "span-name"
+    doc = "span/instant/counter literals exist in the obs span registry"
+
+    def run(self, ctx: Context) -> None:
+        for m in ctx.modules:
+            ctx.violations.extend(self.check_module(m, ctx))
+
+    def check_module(self, module: Module, ctx: Context) -> list[Violation]:
+        if module.tree is None or module.rel.endswith("obs/spans.py"):
+            return []
+        out: list[Violation] = []
+
+        def check(name_node, registry: dict, kind: str, node: ast.AST):
+            if isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str):
+                name = name_node.value
+                ok = name in registry or any(
+                    name.startswith(p) for p in ctx.span_prefixes
+                )
+            elif isinstance(name_node, ast.JoinedStr):
+                head = _fstring_head(name_node)
+                if not head:
+                    return  # fully dynamic: exempt by construction
+                name = head + "{...}"
+                ok = any(head.startswith(p) for p in ctx.span_prefixes)
+            else:
+                return  # a variable: not statically checkable
+            if not ok:
+                out.append(Violation(
+                    module.rel, node.lineno, self.name,
+                    f"{kind} name {name!r} is not declared in "
+                    "ceph_tpu/obs/spans.py (typo'd names orphan their "
+                    "trace events; declare it or fix the spelling)",
+                ))
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if attr == "span" and node.args:
+                check(node.args[0], ctx.spans, "span", node)
+            elif attr == "instant" and node.args:
+                check(node.args[0], ctx.instants, "instant", node)
+            elif (attr == "counter" and node.args
+                    and isinstance(f, ast.Attribute)
+                    and _recv_is_obs(f, module)):
+                check(node.args[0], ctx.trace_counters, "counter", node)
+            elif attr == "JitAccount" or (
+                    attr is not None and attr.endswith("JitAccount")):
+                for kw in node.keywords:
+                    if kw.arg == "span":
+                        check(kw.value, ctx.spans, "JitAccount span", node)
+        return module.filter(out)

@@ -1,0 +1,229 @@
+"""trace-constant: jitted kernels must not bake host arrays into traces.
+
+The r05 blowup class: a jit-wrapped kernel that closes over an
+outer-scope array (or materializes one with `jnp.asarray(closure_var)`)
+embeds that array as a *literal in the trace* — XLA then constant-folds
+it at compile time (BENCH_r05 burned >2 s per compile folding a
+pred[65536,11] constant) and the executable can never be reused for a
+map that differs only in data.  Per-map data must ride as runtime
+operands (the `dev` pytree / table operands), with only structural facts
+baked in.  Until now one runtime jaxpr test guarded one kernel; this
+pass checks every jit site statically.
+
+Detected jit wrappings: `@jax.jit`, `@jit` (from-imported),
+`@partial(jax.jit, ...)`, `jax.jit(f)`, `jax.jit(jax.vmap(f, ...))`
+where `f` is a def or lambda visible in the module.
+
+Flagged inside such a function:
+- a free variable whose binding (enclosing function scope or module
+  level) is an array-constructor call (`np.zeros`, `jnp.asarray`,
+  `jax.device_put`, ...) — the closure becomes a trace constant;
+- `jnp.asarray(...)` / `jnp.array(...)` / `np.asarray(...)` /
+  `np.array(...)` applied to a free variable — same bake-in, spelled
+  explicitly.
+
+The check is lexical: arrays reaching the kernel through parameters are
+operands and never flagged.  Genuinely static closures (a small
+lookup table that must be baked) get a per-line
+`# graftlint: disable=trace-constant`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+_ARRAY_MODS = ("numpy", "jax.numpy")
+_ARRAY_FNS = (
+    "array", "asarray", "zeros", "ones", "arange", "empty", "full",
+    "frombuffer", "fromiter", "linspace", "eye", "stack", "concatenate",
+)
+_MATERIALIZE = {"asarray", "array"}
+
+
+def _is_array_expr(node: ast.AST, module: Module) -> bool:
+    """True when the expression constructs an array on the host/device
+    (the kind that must not be closed over by a jitted kernel)."""
+    if not isinstance(node, ast.Call):
+        return False
+    c = module.canonical(node.func)
+    if c is None:
+        return False
+    if c == "jax.device_put":
+        return True
+    mod, _, attr = c.rpartition(".")
+    return mod in _ARRAY_MODS and attr in _ARRAY_FNS
+
+
+def _is_jit(node: ast.AST, module: Module) -> bool:
+    """Is this expression `jax.jit` (possibly through partial())?"""
+    if module.canonical(node) == "jax.jit":
+        return True
+    if isinstance(node, ast.Call):  # partial(jax.jit, ...)
+        c = module.canonical(node.func)
+        if c in ("functools.partial", "partial") and node.args:
+            return module.canonical(node.args[0]) == "jax.jit"
+    return False
+
+
+def _jit_targets(module: Module):
+    """Yield (function_node, report_node) for every function the module
+    wraps in jax.jit."""
+    tree = module.tree
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit(d, module) for d in node.decorator_list):
+                yield node, node
+        elif isinstance(node, ast.Call) and _is_jit(node.func, module):
+            if not node.args:
+                continue
+            inner = node.args[0]
+            # unwrap jax.vmap(f, ...) chains
+            while (isinstance(inner, ast.Call)
+                   and module.canonical(inner.func) == "jax.vmap"
+                   and inner.args):
+                inner = inner.args[0]
+            if isinstance(inner, ast.Lambda):
+                yield inner, node
+            elif isinstance(inner, ast.Name) and inner.id in defs:
+                yield defs[inner.id], node
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Names bound anywhere inside fn: params (incl. nested defs and
+    comprehensions) and assignment/for/with/import targets."""
+    bound: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                bound.add(arg.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if not isinstance(node, ast.Lambda):
+                bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                bound.add((al.asname or al.name).split(".")[0])
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return bound
+
+
+def _enclosing_array_bindings(module: Module) -> dict[int, dict[str, int]]:
+    """For every function node (by id()), the array-constructor bindings
+    visible at that point: maps name -> binding line.  Built per scope
+    (module level + each function), child scopes inherit."""
+    tree = module.tree
+    out: dict[int, dict[str, int]] = {}
+
+    def walk_scope(stmts):
+        """Walk statements without descending into nested scopes."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                    stack.append(child)
+
+    def scope_bindings(body) -> dict[str, int]:
+        b: dict[str, int] = {}
+        for node in walk_scope(body):
+            if isinstance(node, ast.Assign):
+                if _is_array_expr(node.value, module):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            b[t.id] = node.lineno
+        return b
+
+    def visit(node, inherited: dict[str, int]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            own = dict(inherited)
+            own.update(scope_bindings(node.body))
+            out[id(node)] = own
+            for child in ast.iter_child_nodes(node):
+                visit(child, own)
+        elif isinstance(node, ast.Lambda):
+            out[id(node)] = dict(inherited)
+        else:
+            for child in ast.iter_child_nodes(node):
+                visit(child, inherited)
+
+    top = scope_bindings(tree.body)
+    for child in ast.iter_child_nodes(tree):
+        visit(child, top)
+    out[id(tree)] = top
+    return out
+
+
+@register
+class TraceConstantPass(Pass):
+    name = "trace-constant"
+    doc = "jitted kernels must not close over / materialize host arrays"
+
+    def run(self, ctx: Context) -> None:
+        for m in ctx.modules:
+            ctx.violations.extend(self.check_module(m, ctx))
+
+    def check_module(self, module: Module, ctx: Context) -> list[Violation]:
+        if module.tree is None:
+            return []
+        out: list[Violation] = []
+        bindings = _enclosing_array_bindings(module)
+        seen: set[tuple[int, int]] = set()
+        for fn, report_node in _jit_targets(module):
+            visible = bindings.get(id(fn), bindings[id(module.tree)])
+            bound = _bound_names(fn)
+            body = fn.body if isinstance(fn, ast.Lambda) else fn
+            for node in ast.walk(body):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id not in bound
+                        and node.id in visible):
+                    key = (node.lineno, node.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Violation(
+                        module.rel, node.lineno, self.name,
+                        f"jitted kernel closes over array "
+                        f"'{node.id}' (bound at line "
+                        f"{visible[node.id]}) — it becomes a trace "
+                        "constant; pass it as an operand",
+                    ))
+                if isinstance(node, ast.Call):
+                    c = module.canonical(node.func)
+                    if c is None or not node.args:
+                        continue
+                    mod, _, attr = c.rpartition(".")
+                    a0 = node.args[0]
+                    if (mod in _ARRAY_MODS and attr in _MATERIALIZE
+                            and isinstance(a0, ast.Name)
+                            and a0.id not in bound):
+                        key = (node.lineno, node.col_offset)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        out.append(Violation(
+                            module.rel, node.lineno, self.name,
+                            f"{attr}() materializes non-static "
+                            f"'{a0.id}' inside a jitted kernel — it "
+                            "becomes a trace constant; pass it as an "
+                            "operand",
+                        ))
+        return module.filter(out)
