@@ -53,6 +53,17 @@ A `balancer` stage runs one optimization round of each mgr balancer
 mode (upmap / crush-compat, ceph_tpu.mgr) on a synthetic cluster so the
 BENCH JSON records balancer eval throughput and score deltas.
 
+Output observability (docs/BENCH_SCHEMA.md is the field contract; the
+record carries `schema_version`): the final JSON embeds an
+`executables` section (the compile-cache registry with per-kernel cost
+analysis and rooflines, ceph_tpu.obs.executables) and a `quantiles`
+section (p50/p90/p99 of the hot dispatch spans).  `--diff-against
+'BENCH_r*.json'` diffs the fresh run against a prior series through
+tools/benchdiff (calibration-normalized, regressions flagged inline in
+the output), and `--selftest` additionally runs the differ over a
+frozen fixture series and fails unless the seeded regression is
+flagged.
+
 Env knobs: BENCH_PGS, BENCH_OSDS, BENCH_BASELINE_PGS, BENCH_EC_MB,
 BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU,
 BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
@@ -64,6 +75,7 @@ ec.jax_backend strategy; the ec_jax stage measures all of them anyway).
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import signal
@@ -78,6 +90,15 @@ from ceph_tpu import obs, runtime
 
 _HERE = Path(__file__).resolve().parent
 sys.path.insert(0, str(_HERE / "tests"))
+
+# the BENCH record shape this file writes; the reader contract lives in
+# tools/benchdiff.py (docs/BENCH_SCHEMA.md documents the fields)
+from tools.benchdiff import SCHEMA_VERSION  # noqa: E402
+
+# frozen benchdiff fixture series (built from the real BENCH_r01-r05
+# rounds + synthetic calibrated rounds with a seeded regression); the
+# selftest runs the differ over it and embeds the verdict
+BENCHDIFF_FIXTURES = _HERE / "tests" / "data" / "benchdiff"
 
 N_PGS = int(os.environ.get("BENCH_PGS", 1_000_000))
 N_OSDS = int(os.environ.get("BENCH_OSDS", 1024))
@@ -196,8 +217,22 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
             phist = _hist(actp[:, None], DV, mask[:, None])
             return hist, phist
 
-        stats_block = obs.JitAccount(stats_block, pl, "bench_stats")
-        rescue_block = obs.JitAccount(rescue_block, pl, "bench_rescue")
+        # _BENCH_JITS entries register in the executable registry like
+        # every other trace-once cache (compile cost + lazy cost
+        # analysis land in the `executables` output section)
+        stats_block = obs.JitAccount(
+            stats_block, pl, "bench_stats",
+            exec_record=obs.executables.register(
+                "bench", "stats", bkey, fn=stats_block),
+            # one logical distribution with the PoolMapper fast kernel:
+            # warm stats-block dispatches ARE map_block dispatches
+            warm_hist="map_block_seconds",
+        )
+        rescue_block = obs.JitAccount(
+            rescue_block, pl, "bench_rescue",
+            exec_record=obs.executables.register(
+                "bench", "rescue", bkey, fn=rescue_block),
+        )
         _BENCH_JITS[bkey] = ent = (stats_block, rescue_block)
     stats_block, rescue_block = ent
 
@@ -722,6 +757,7 @@ def worker() -> None:
     ck = runtime.Checkpoint(
         PARTIAL, resume=bool(os.environ.get("BENCH_RESUME"))
     )
+    ck.data["schema_version"] = SCHEMA_VERSION
     t_start = float(os.environ.get("BENCH_T0", time.time()))
     sched = runtime.StageScheduler(ck, DEADLINE_S, t0=t_start)
     _acquire(ck)
@@ -812,6 +848,12 @@ def worker() -> None:
     sched.add("headline", headline, priority=40, est_s=120,
               min_budget_s=90)
     sched.run()
+    # final executable-registry snapshot, cost-analyzed: which compiled
+    # programs this run built, what each costs per dispatch, and how
+    # close each is to roofline.  progress(): stored + flushed, never a
+    # stage (a --resume must not skip the stages behind it).
+    ck.progress("executables",
+                obs.executables.dump(analyze="full", budget_s=20.0))
 
 
 # -------------------------------------------------------------- supervisor
@@ -822,6 +864,28 @@ def _strip_perf(stage):
     if isinstance(stage, dict):
         return {k: v for k, v in stage.items() if k != "perf"}
     return stage
+
+
+def _quantile_section(perf: dict) -> dict:
+    """p50/p90/p99 of the hot dispatch spans from a perf snapshot — the
+    tail-latency record the serve-stage QPS targets will be written
+    against (quantile-kind counters, ceph_tpu.obs.quantiles)."""
+    out = {}
+    for span, grp, key in (
+        ("pipeline.map_block", "pipeline", "map_block_seconds"),
+        # registered span-name bases (obs/spans.py), so the section
+        # cross-references cleanly against traces
+        ("ec.gf_matmul_batch", "ec", "gf_batch_dispatch_hist"),
+        ("balancer.round", "balancer", "round_hist"),
+    ):
+        rec = (perf.get(grp) or {}).get(key)
+        if isinstance(rec, dict) and rec.get("count"):
+            out[span] = {
+                k: (round(rec[k], 6) if isinstance(rec[k], float)
+                    else rec[k])
+                for k in ("p50", "p90", "p99", "count") if k in rec
+            }
+    return out
 
 
 def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
@@ -840,6 +904,7 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
     vs = head.get("vs_c", 0.0)
     out = {
         "metric": "pg_mappings_per_sec",
+        "schema_version": SCHEMA_VERSION,
         "value": value,
         "unit": "mappings/s",
         "vs_baseline": vs,
@@ -864,6 +929,11 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         out["stages_done"] = list(stages["stages_done"])
     if "balancer" in stages:
         out["balancer"] = _strip_perf(stages["balancer"])
+    if "executables" in stages:
+        out["executables"] = stages["executables"]
+    q = _quantile_section(stages.get("perf") or {})
+    if q:
+        out["quantiles"] = q
     if "rebalance" in stages:
         rb = _strip_perf(stages["rebalance"])
         key = "rebalance"
@@ -932,7 +1002,30 @@ def _run_worker(env: dict, deadline: float,
     return None, reason
 
 
-def supervise(resume: bool = False) -> None:
+def _diff_against(out: dict, pattern: str) -> dict:
+    """Diff this run's assembled record against a prior series
+    (`--diff-against 'BENCH_r*.json'`); the summary rides in the output
+    JSON so the regression check is part of the bench record itself."""
+    from tools.benchdiff import Round, diff_series, load_series
+
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        return {"error": f"no files match {pattern!r}"}
+    rounds = load_series(paths)
+    rounds.append(Round("current", out))
+    with obs.span("bench.diff", rounds=len(rounds)):
+        rep = diff_series(rounds)
+    return {
+        "verdict": rep["verdict"],
+        "rounds": [r["round"] for r in rep["rounds"]],
+        "gaps": [g["round"] for g in rep["gaps"]],
+        "regressions": rep["regressions"],
+        "improvements": len(rep["improvements"]),
+        "calibration_ref_gbps": rep["calibration_ref_gbps"],
+    }
+
+
+def supervise(resume: bool = False, diff_pattern: str | None = None) -> None:
     from ceph_tpu.obs import admin_socket
 
     admin_socket.release()  # the worker owns CEPH_TPU_ADMIN_SOCKET
@@ -973,7 +1066,13 @@ def supervise(resume: bool = False) -> None:
             if reason:
                 notes.append(f"cpu retry: {reason}")
             stages = _read_partial()
-    print(json.dumps(_assemble(stages, notes, time.time() - t0)))
+    out = _assemble(stages, notes, time.time() - t0)
+    if diff_pattern:
+        try:
+            out["benchdiff"] = _diff_against(out, diff_pattern)
+        except Exception as e:  # the diff must never eat the numbers
+            out["benchdiff"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    print(json.dumps(out))
 
 
 # -------------------------------------------------------------- selftest
@@ -1034,6 +1133,61 @@ def _selftest_graftlint(problems: list[str]) -> dict:
                                 "elapsed_s") if k in rep}
 
 
+def _selftest_executables(out: dict, problems: list[str]) -> dict:
+    """The executable-registry acceptance gate: the run must have
+    registered and cost-analyzed at least one pipeline-side executable
+    (pipe/kernel/bench caches all compile the mapping pipeline) and one
+    EC executable — otherwise the registry is decorative."""
+    ex = out.get("executables") or {}
+    entries = ex.get("entries") or []
+
+    def analyzed(e):
+        return isinstance(e.get("cost"), dict) and "error" not in e["cost"]
+
+    if not entries:
+        problems.append("executables registry section empty")
+    else:
+        if not any(e.get("cache") in ("pipe", "kernel", "bench")
+                   and analyzed(e) for e in entries):
+            problems.append(
+                "no cost-analyzed pipeline executable in the registry")
+        if not any(e.get("cache") == "ec" and analyzed(e)
+                   for e in entries):
+            problems.append("no cost-analyzed EC executable in the registry")
+    return {
+        "entries": len(entries),
+        "by_cache": ex.get("by_cache"),
+        "cost_analyzed": ex.get("cost_analyzed"),
+        "total_compile_seconds": ex.get("total_compile_seconds"),
+    }
+
+
+def _selftest_benchdiff(problems: list[str]) -> dict:
+    """Run the trajectory differ over the frozen fixture series (real
+    r01-r05 rounds incl. the r02 gap, plus synthetic calibrated rounds
+    with a seeded regression).  The differ must flag the seed — a
+    differ that cannot see a planted regression guards nothing."""
+    from tools.benchdiff import diff_series, load_series
+
+    try:
+        paths = sorted(BENCHDIFF_FIXTURES.glob("*.json"))
+        rep = diff_series(load_series(paths))
+    except Exception as e:
+        problems.append(f"benchdiff fixture run failed: {e!r}")
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+    if rep["verdict"] != "regression" or not rep["regressions"]:
+        problems.append(
+            "benchdiff did not flag the regression seeded in the fixture "
+            "series")
+    return {
+        "verdict": rep["verdict"],
+        "rounds": len(rep["rounds"]),
+        "gaps": len(rep["gaps"]),
+        "regressions": len(rep["regressions"]),
+        "flagged": sorted({d["metric"] for d in rep["regressions"]})[:6],
+    }
+
+
 def selftest() -> int:
     """<60s CPU-only survivability check: inject a TPU-init hang, then
     require that EVERY stage (including a miniature rebalance) completes
@@ -1079,7 +1233,17 @@ def selftest() -> int:
             problems.append(f"attempts={out.get('attempts')}, wanted >=2")
         if not out.get("value", 0) > 0:
             problems.append("headline value is zero")
+        if out.get("schema_version") != SCHEMA_VERSION:
+            problems.append(
+                f"schema_version={out.get('schema_version')!r}, wanted "
+                f"{SCHEMA_VERSION}")
+        q = (out.get("quantiles") or {}).get("pipeline.map_block") or {}
+        if not (q.get("p50", 0) > 0 and q.get("p99", 0) > 0):
+            problems.append(
+                "no p50/p99 for pipeline.map_block dispatch in the output")
     lint = _selftest_graftlint(problems)
+    execs = _selftest_executables(out, problems)
+    bdiff = _selftest_benchdiff(problems)
     verdict = {
         "selftest": "ok" if not problems else "FAIL",
         "elapsed_s": round(time.time() - t0, 1),
@@ -1088,6 +1252,9 @@ def selftest() -> int:
         "fallback_reason": out.get("fallback_reason"),
         "attempts": out.get("attempts"),
         "graftlint": lint,
+        "executables": execs,
+        "quantiles": out.get("quantiles"),
+        "benchdiff": bdiff,
     }
     if problems:
         verdict["problems"] = problems
@@ -1102,5 +1269,21 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_WORKER"):
         worker()
     else:
+        diff_pattern = None
+        for i, arg in enumerate(sys.argv):
+            if arg == "--diff-against":
+                if (i + 1 >= len(sys.argv)
+                        or sys.argv[i + 1].startswith("-")):
+                    # refuse to swallow a following flag as the glob —
+                    # the run would silently proceed with wrong semantics
+                    _log("--diff-against needs a path/glob argument")
+                    raise SystemExit(2)
+                diff_pattern = sys.argv[i + 1]
+            elif arg.startswith("--diff-against="):
+                diff_pattern = arg.split("=", 1)[1]
+                if not diff_pattern:
+                    _log("--diff-against needs a path/glob argument")
+                    raise SystemExit(2)
         supervise(resume="--resume" in sys.argv
-                  or bool(os.environ.get("BENCH_RESUME")))
+                  or bool(os.environ.get("BENCH_RESUME")),
+                  diff_pattern=diff_pattern)

@@ -224,6 +224,8 @@ _L.add_u64("changes_rejected", "upmap-item changes rolled back (stddev up)")
 _L.add_avg("stddev", "PG-count deviation stddev after each accepted change")
 _L.add_avg("max_deviation", "max abs deviation after each accepted change")
 _L.add_time_avg("round_seconds", "wall time per optimizer round")
+_L.add_quantile("round_hist",
+                "optimizer round wall-time distribution (p50/p99)")
 _L.add_time_avg("build_state_seconds", "O(PGs) membership-state build time")
 
 
@@ -354,7 +356,7 @@ def calc_pg_upmaps(
         _L.inc("rounds")
         with obs.span(
             "balancer.round", iteration=max_iter - iter_left
-        ), _L.time("round_seconds"):
+        ), _L.time("round_seconds"), _L.time("round_hist"):
             by_dev = sorted(
                 osd_deviation.items(), key=lambda kv: (kv[1], kv[0])
             )

@@ -14,10 +14,13 @@ the same commands work here in two modes:
 Commands (reference names):
 
     perf dump     perf-dump JSON (u64 bare, avg/time_avg avgcount+sum,
-                  histogram bounds+buckets)
+                  histogram bounds+buckets, quantile + p50/p90/p99) plus
+                  the `executables` compile-cache registry section
     perf schema   kind + description per counter
     perf reset    zero every counter, keep declarations
     metrics       Prometheus text exposition (format 0.0.4)
+    cache dump    executable registry with JAX cost/memory analysis
+                  (flops, bytes accessed, peak temp memory, rooflines)
     trace flush   write the Chrome trace-event file (CEPH_TPU_TRACE)
     runtime       backend-acquisition provenance (ceph_tpu.runtime:
                   backend, fallback_reason, attempts) + armed faults
@@ -124,7 +127,8 @@ def main(argv: list[str] | None = None) -> int:
 
     # read-only commands benefit from a populated registry; mutating or
     # metadata commands run against the process as-is
-    if cmd in ("perf dump", "perf schema", "metrics") and not args.no_selftest:
+    if (cmd in ("perf dump", "perf schema", "metrics", "cache dump")
+            and not args.no_selftest):
         _selftest()
     print(asok.handle_command(cmd))
     return 0

@@ -42,6 +42,7 @@ from ceph_tpu.core.lntable import (
 from ceph_tpu.core.rjenkins import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from ceph_tpu.crush.soa import CrushArrays
 from ceph_tpu.crush.types import BucketAlg, ITEM_NONE, RuleOp
+from ceph_tpu.obs import executables as _executables
 
 S64_MIN = -(2**63)  # plain int: converted at trace time (keeps import
                     # free of device ops so backend fallback can happen)
@@ -1822,6 +1823,9 @@ def compile_batched(A: CrushArrays, ruleno: int, result_max: int,
                 res, flg = lax.map(lambda b: vfast(b, dev_weights, tb),
                                    blocks)
                 return res.reshape(n, -1), flg.reshape(n)
+        # every _KERNEL_CACHE entry registers in the executable registry
+        # (compile cost / dispatch counts / lazy cost analysis)
+        jfast = _executables.wrap(jfast, "kernel", "batched_fast", fkey)
         _KERNEL_CACHE[fkey] = jfast
 
     def run(xs, dev_weights, device: bool = False):
@@ -1832,7 +1836,10 @@ def compile_batched(A: CrushArrays, ruleno: int, result_max: int,
             lkey = ("batched_loop", loop.cache_key)
             jloop = _KERNEL_CACHE.get(lkey)
             if jloop is None:
-                jloop = jax.jit(jax.vmap(loop, in_axes=(0, None, None)))
+                jloop = _executables.wrap(
+                    jax.jit(jax.vmap(loop, in_axes=(0, None, None))),
+                    "kernel", "batched_loop", lkey,
+                )
                 _KERNEL_CACHE[lkey] = jloop
             xs = np.asarray(xs)
             idx = np.nonzero(flg)[0]

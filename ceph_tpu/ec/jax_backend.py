@@ -285,7 +285,10 @@ def _ec_cached(key: tuple, build):
     fn = _EC_CACHE.get(key)
     if fn is None:
         _L.inc("pipe_cache_misses")
-        fn = build()
+        # executable-registry record per cache entry (key[0] is the
+        # strategy/kind tag): compile cost, dispatch counts, and lazy
+        # cost analysis become visible in `perf dump` / `cache dump`
+        fn = obs.executables.wrap(build(), "ec", str(key[0]), key)
         _EC_CACHE[key] = fn
     else:
         _L.inc("pipe_cache_hits")

@@ -28,7 +28,7 @@ Importing this package is cheap (no jax import) and, when
 
 from __future__ import annotations
 
-from ceph_tpu.obs import spans, trace
+from ceph_tpu.obs import executables, quantiles, spans, trace
 from ceph_tpu.obs.admin_socket import maybe_start_from_env
 from ceph_tpu.obs.jax_accounting import JitAccount, timed_fetch
 from ceph_tpu.obs.trace import (
@@ -49,10 +49,12 @@ from ceph_tpu.utils.perf_counters import (
 
 
 def prometheus_text() -> str:
-    """Prometheus text exposition of the whole perf registry."""
+    """Prometheus text exposition of the whole perf registry, plus the
+    executable-registry gauges (per-cache entry counts, compile seconds,
+    dispatch totals)."""
     from ceph_tpu.obs.prometheus import prometheus_text as _render
 
-    return _render(perf_dump())
+    return _render(perf_dump()) + executables.prometheus_gauges()
 
 
 def jit_counters() -> dict:
@@ -90,6 +92,7 @@ __all__ = [
     "JitAccount",
     "UndeclaredCounterError",
     "counter",
+    "executables",
     "flush",
     "instant",
     "jit_counters",
@@ -98,6 +101,7 @@ __all__ = [
     "perf_dump",
     "perf_schema",
     "prometheus_text",
+    "quantiles",
     "reset_values",
     "set_trace_path",
     "span",

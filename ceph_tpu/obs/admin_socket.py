@@ -5,10 +5,16 @@ The reference exposes every daemon's internals on a UNIX stream socket
 one command line per connection, JSON reply, connection closed).  Same
 protocol here:
 
-    client: "perf dump\\n"      server: perf-dump JSON
+    client: "perf dump\\n"      server: perf-dump JSON (+ an `executables`
+                                section: the compile-cache registry,
+                                records only — no analysis work)
     client: "perf schema\\n"    server: perf-schema JSON
     client: "perf reset\\n"     server: {"ok": true} (values zeroed)
     client: "metrics\\n"        server: Prometheus text exposition
+    client: "cache dump\\n"     server: executable registry with lazy JAX
+                                cost/memory analysis (may trace; do not
+                                point it at a wedged device — `perf dump`
+                                is the always-answers path)
     client: "trace flush\\n"    server: {"path": <trace file or null>}
     client: "runtime\\n"        server: backend-acquisition provenance
                                 + armed fault points
@@ -35,28 +41,40 @@ _log = subsys_logger("obs")
 _server: "AdminSocket | None" = None
 
 COMMANDS = (
-    "perf dump", "perf schema", "perf reset", "metrics", "trace flush",
-    "runtime", "help",
+    "perf dump", "perf schema", "perf reset", "metrics", "cache dump",
+    "trace flush", "runtime", "help",
 )
 
 
 def handle_command(cmd: str) -> str:
     """Execute one admin command against this process; returns the reply
     text.  Shared by the socket server and the in-process CLI path."""
-    from ceph_tpu.obs import trace
-    from ceph_tpu.obs.prometheus import prometheus_text
+    from ceph_tpu import obs
+    from ceph_tpu.obs import executables, trace
     from ceph_tpu.utils import perf_counters as pc
 
     cmd = " ".join(cmd.split())
     if cmd == "perf dump":
-        return json.dumps(pc.perf_dump(), indent=1, sort_keys=True)
+        # analyze=False: a live query (possibly against a process whose
+        # device is wedged) must answer without touching jax
+        d = pc.perf_dump()
+        d["executables"] = executables.dump(analyze=False)
+        return json.dumps(d, indent=1, sort_keys=True)
     if cmd == "perf schema":
         return json.dumps(pc.perf_schema(), indent=1, sort_keys=True)
     if cmd == "perf reset":
         pc.reset_values()
         return json.dumps({"ok": True})
     if cmd == "metrics":
-        return prometheus_text(pc.perf_dump())
+        # the one exposition recipe lives in obs.prometheus_text()
+        # (counters + executable-registry gauges)
+        return obs.prometheus_text()
+    if cmd == "cache dump":
+        # short analysis budget: a live diagnostic must answer promptly;
+        # entries beyond it keep cost=null (re-query to resume — results
+        # cache per record)
+        return json.dumps(executables.dump(analyze=True, budget_s=5.0),
+                          indent=1, sort_keys=True)
     if cmd == "trace flush":
         return json.dumps({"path": trace.flush()})
     if cmd == "runtime":

@@ -1,0 +1,370 @@
+"""Executable registry — per-compile metadata for the trace-once caches.
+
+The trace-once stack made compiled executables the unit of performance
+(`_PIPE_CACHE` / `_KERNEL_CACHE` / `_EC_CACHE`), but until now they were
+invisible: the caches exposed aggregate hit/miss counters and nothing
+else.  Tuning `_PALLAS_TILE` for MXU occupancy or setting a serve-stage
+QPS budget needs per-executable facts — what does this kernel cost to
+compile, how often does it dispatch, how many flops/bytes does one
+dispatch move, and how close is it to the roofline.
+
+Every cache registers its compiled entries here (the caches stay the
+owners; this module only observes):
+
+- `register()` creates a metadata record at cache-miss time (cheap:
+  refs only, no jax work);
+- `JitAccount(..., exec_record=rec)` feeds per-call compile/dispatch
+  wall time into the record; `wrap()` does the same for raw jitted
+  callables that have no JitAccount (the EC and batched-kernel caches);
+- `dump()` renders the registry: per-entry cache_key digest, compile
+  seconds, hit counts, last use, and — lazily, cached per record — JAX
+  `Lowered.cost_analysis()` (flops, bytes accessed) plus
+  `Compiled.memory_analysis()` (peak temp bytes) where the backend
+  provides them, with derived roofline numbers (achieved GB/s and
+  flops/s from the dispatch timings).
+
+Cost analysis re-lowers the function from a recorded ShapeDtypeStruct
+signature (never from live buffers — the registry must not pin operand
+memory).  Lowering is trace-cache-warm and cheap; the XLA *compile*
+needed for memory_analysis is only attempted when the record's own
+measured compile time was under `_MEM_COMPILE_MAX_S`, so dumping the
+registry can never re-pay a 20 s pipeline compile.  `dump(analyze=False)`
+does no jax work at all — the admin-socket `perf dump` path uses it,
+because a live query against a wedged device must still answer.
+
+Dispatch timings measure enqueue (JitAccount's honest-for-async
+contract), so on accelerators the derived GB/s is an upper bound; on the
+CPU backend dispatch is effectively synchronous and the number is real.
+
+Import-light: jax is only imported inside analysis calls, which only
+run after a jitted callable has already executed in this process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from ceph_tpu.obs import trace
+from ceph_tpu.obs.jax_accounting import _sig
+
+# registry insertion order is kept (dict semantics): dumps list entries
+# oldest-compile first within a cache
+_REG: dict[tuple, "ExecRecord"] = {}
+_LOCK = threading.Lock()
+
+# memory_analysis needs a real XLA compile; only re-pay it for records
+# whose measured compile was at most this many seconds
+_MEM_COMPILE_MAX_S = 5.0
+
+# cost-analysis keys kept from the raw backend dict (the rest are
+# per-operand utilization details nobody reads from a dump)
+_COST_KEYS = (
+    ("flops", "flops"),
+    ("bytes accessed", "bytes_accessed"),
+    ("transcendentals", "transcendentals"),
+)
+
+
+def _digest(key) -> str:
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+
+def _shape_spec(args: tuple, kw: dict):
+    """args/kwargs with every array leaf replaced by ShapeDtypeStruct —
+    enough to re-lower later, without keeping device buffers alive."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, (args, kw))
+
+
+class ExecRecord:
+    """Metadata for one compiled executable of one trace-once cache."""
+
+    def __init__(self, cache: str, kind: str, key):
+        self.cache = cache  # "pipe" | "kernel" | "ec" | "bench"
+        self.kind = kind  # e.g. "fast", "loop", "xor", "batched_fast"
+        self.key_digest = _digest(key)
+        self.key_repr = repr(key)[:240]
+        self.created = time.time()
+        self.last_use = self.created
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.hits = 0  # steady-state dispatches
+        self.dispatch_seconds = 0.0
+        # per-record lock: call accounting sits on the innermost
+        # dispatch paths, and independent kernels must not contend on
+        # one process-wide mutex (the module _LOCK guards only the
+        # registry's shape — register/records/reset)
+        self._lock = threading.Lock()
+        self._fn = None  # the jitted callable (for re-lowering)
+        self._spec = None  # (args, kw) ShapeDtypeStruct pytree
+        self._cost: dict | None = None  # cached analysis (or {"error"})
+        self._mem_tried = False  # memory analysis ATTEMPTED (it may
+        # legitimately yield nothing on some backends; the attempt must
+        # still count, or every "full" dump would re-compile forever)
+
+    def note_call(self, dt: float, cold: bool, args=None, kw=None) -> None:
+        """Book one call; on a cold call, snapshot the arg signature so
+        the executable can be re-lowered for analysis later."""
+        with self._lock:
+            self.last_use = time.time()
+            if cold:
+                self.compiles += 1
+                self.compile_seconds += dt
+            else:
+                self.hits += 1
+                self.dispatch_seconds += dt
+        if cold and self._spec is None and args is not None:
+            try:
+                self._spec = _shape_spec(args, kw or {})
+            except Exception:  # exotic operand pytree: lose analysis,
+                self._spec = None  # never the caller's dispatch
+
+    # -- analysis --------------------------------------------------------
+    def _mem_eligible(self) -> bool:
+        return self.compile_seconds <= _MEM_COMPILE_MAX_S
+
+    def analysis_pending(self, memory: bool = False) -> bool:
+        """True when analyze(memory=...) would actually do jax work —
+        dump() uses this to apply its budget only to real work, and to
+        keep serving already-cached results for free."""
+        if self._fn is None or self._spec is None or not hasattr(
+                self._fn, "lower"):
+            return False
+        if self._cost is None:
+            return True
+        if "error" in self._cost:
+            return False  # tried and failed: don't hammer the backend
+        return memory and not self._mem_tried and self._mem_eligible()
+
+    def analyze(self, memory: bool = False) -> dict | None:
+        """Cost (and optionally memory) analysis, computed once and
+        cached.  The default is COST ONLY — `Lowered.cost_analysis()`
+        needs a (trace-cache-warm) re-lower but no XLA compile, so it is
+        cheap even for the big pipeline kernels.  `memory=True` adds
+        `Compiled.memory_analysis()` (peak temp bytes), which *does*
+        compile: it is attempted AT MOST ONCE, and only when the
+        record's own measured compile time was at most
+        _MEM_COMPILE_MAX_S (only the bench end-of-run dump asks for it).
+        Returns the cost dict, {"error": ...} when the backend refused,
+        or None when the record has nothing to analyze (no jitted fn /
+        no spec)."""
+        if not self.analysis_pending(memory):
+            return self._cost
+        fn, spec = self._fn, self._spec
+        try:
+            lowered = fn.lower(*spec[0], **spec[1])
+            raw = lowered.cost_analysis()
+            if isinstance(raw, (list, tuple)):  # older jax returns [dict]
+                raw = raw[0] if raw else {}
+            cost = {
+                out: float(raw[src]) for src, out in _COST_KEYS
+                if src in raw
+            }
+            if memory and self._mem_eligible():
+                self._mem_tried = True
+                try:
+                    mem = lowered.compile().memory_analysis()
+                    if mem is not None:
+                        cost["peak_temp_bytes"] = int(
+                            getattr(mem, "temp_size_in_bytes", 0)
+                        )
+                        cost["argument_bytes"] = int(
+                            getattr(mem, "argument_size_in_bytes", 0)
+                        )
+                        cost["output_bytes"] = int(
+                            getattr(mem, "output_size_in_bytes", 0)
+                        )
+                except Exception:  # backend has no memory stats: fine
+                    pass
+            self._cost = cost
+        except Exception as e:  # analysis is best-effort by contract
+            if memory:
+                # the attempt counts even when it fails (a wedged
+                # device must not be re-lowered on every later dump)
+                self._mem_tried = True
+            if self._cost is None or "error" in self._cost:
+                self._cost = {"error": f"{type(e).__name__}: {e}"[:200]}
+            # else: a later memory pass failed — keep the good cached
+            # cost rather than clobbering it with the error
+        return self._cost
+
+    def summary(self, analyze: bool = False) -> dict:
+        cost = self.analyze() if analyze else self._cost
+        out = {
+            "cache": self.cache,
+            "kind": self.kind,
+            "key": self.key_digest,
+            "cache_key": self.key_repr,
+            "compiles": self.compiles,
+            "compile_seconds": round(self.compile_seconds, 4),
+            "hits": self.hits,
+            "dispatch_seconds": round(self.dispatch_seconds, 4),
+            "last_use_unix": round(self.last_use, 1),
+            "cost": cost,
+        }
+        if cost and "error" not in cost and self.hits:
+            per = self.dispatch_seconds / self.hits
+            roof = {"dispatch_avg_s": round(per, 6)}
+            if per > 0:
+                ba = cost.get("bytes_accessed")
+                fl = cost.get("flops")
+                if ba:
+                    roof["achieved_gbps"] = round(ba / per / 1e9, 3)
+                if fl:
+                    roof["achieved_gflops"] = round(fl / per / 1e9, 3)
+            out["roofline"] = roof
+        return out
+
+
+def register(cache: str, kind: str, key, fn=None) -> ExecRecord:
+    """Create (or return) the record for one compiled cache entry.
+    Called at cache-miss time by the owning cache; `fn` is the jitted
+    callable (kept by reference — the cache keeps it alive anyway)."""
+    rk = (cache, kind, _digest(key))
+    with _LOCK:
+        rec = _REG.get(rk)
+        if rec is None:
+            rec = _REG[rk] = ExecRecord(cache, kind, key)
+    if fn is not None and rec._fn is None:
+        rec._fn = fn
+    return rec
+
+
+class _Instrumented:
+    """Call-through wrapper for caches that store raw jitted callables
+    (no JitAccount): books compile/dispatch splits into the record with
+    the same first-call-per-signature cold detection JitAccount uses."""
+
+    __slots__ = ("fn", "rec", "_seen")
+
+    def __init__(self, fn, rec: ExecRecord):
+        self.fn = fn
+        self.rec = rec
+        self._seen: set[tuple] = set()
+
+    def __call__(self, *args, **kw):
+        sig = _sig(args)
+        cold = sig not in self._seen
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kw)
+        dt = time.perf_counter() - t0
+        if cold:
+            self._seen.add(sig)
+        self.rec.note_call(dt, cold, args if cold else None,
+                           kw if cold else None)
+        return out
+
+
+def wrap(fn, cache: str, kind: str, key):
+    """Register `fn` and return it wrapped with call accounting — the
+    one-liner for _EC_CACHE / _KERNEL_CACHE build sites."""
+    return _Instrumented(fn, register(cache, kind, key, fn=fn))
+
+
+def dump(analyze: bool | str = True, budget_s: float = 10.0) -> dict:
+    """The `executables` section: every registered record, plus per-cache
+    totals.  analyze=True cost-analyzes records (cached after the first
+    dump; lowering only, no XLA compile) until `budget_s` of wall clock
+    is spent — later entries keep cost=None rather than stalling a
+    diagnostic dump.  analyze="full" additionally collects memory
+    analysis (the bench end-of-run snapshot; see ExecRecord.analyze)."""
+    with _LOCK:
+        recs = list(_REG.values())
+    entries = []
+    memory = analyze == "full"
+    t0 = time.perf_counter()
+    with trace.span("obs.exec_analyze", entries=len(recs)):
+        for rec in recs:
+            if analyze and rec.analysis_pending(memory):
+                # the budget must bound work BEFORE it starts, so
+                # estimate from the record's measured compile time:
+                # memory mode re-pays the compile itself (~1.5x), while
+                # a cost-only re-lower is trace-cache-warm python with
+                # no XLA (~0.2x) — a big pipeline kernel must still fit
+                # the daemon's 5s budget, it is the registry's primary
+                # target.  Cached results are always served for free.
+                remaining = budget_s - (time.perf_counter() - t0)
+                est = rec.compile_seconds * (
+                    1.5 if memory and rec._mem_eligible() else 0.2
+                )
+                if remaining > 0 and est <= remaining:
+                    rec.analyze(memory=memory)
+            entries.append(rec.summary())
+    by_cache: dict[str, int] = {}
+    for e in entries:
+        by_cache[e["cache"]] = by_cache.get(e["cache"], 0) + 1
+    return {
+        "entries": entries,
+        "by_cache": by_cache,
+        "cost_analyzed": sum(
+            1 for e in entries
+            if e["cost"] and "error" not in e["cost"]
+        ),
+        "total_compile_seconds": round(
+            sum(e["compile_seconds"] for e in entries), 3
+        ),
+    }
+
+
+def prometheus_gauges() -> str:
+    """Aggregate registry gauges appended to the metrics exposition —
+    per-cache entry counts, compile seconds, dispatch counts."""
+    with _LOCK:
+        recs = list(_REG.values())
+    per: dict[str, list] = {}
+    for r in recs:
+        agg = per.setdefault(r.cache, [0, 0.0, 0])
+        agg[0] += 1
+        agg[1] += r.compile_seconds
+        agg[2] += r.hits
+    if not per:
+        return ""
+    lines = []
+    # the `_total` series are monotone accumulations -> counter type
+    # (Prometheus reserves the _total suffix for counters); the entry
+    # count can shrink on reset() -> gauge
+    for metric, help_, mtype, idx, fmt in (
+        ("ceph_tpu_executables_registered",
+         "compiled executables registered per trace-once cache",
+         "gauge", 0, str),
+        ("ceph_tpu_executables_compile_seconds_total",
+         "wall seconds spent compiling, per cache",
+         "counter", 1, lambda v: repr(round(v, 4))),
+        ("ceph_tpu_executables_dispatches_total",
+         "steady-state dispatches served, per cache",
+         "counter", 2, str),
+    ):
+        lines.append(f"# HELP {metric} {help_}")
+        lines.append(f"# TYPE {metric} {mtype}")
+        for cache in sorted(per):
+            lines.append(
+                f'{metric}{{cache="{cache}"}} {fmt(per[cache][idx])}'
+            )
+    return "\n".join(lines) + "\n"
+
+
+def records(cache: str | None = None, kind: str | None = None
+            ) -> list[ExecRecord]:
+    """Live records, optionally filtered — lets callers analyze a
+    *specific* executable without paying for a whole-registry sweep."""
+    with _LOCK:
+        return [
+            r for r in _REG.values()
+            if (cache is None or r.cache == cache)
+            and (kind is None or r.kind == kind)
+        ]
+
+
+def reset() -> None:
+    """Test isolation: drop every record (unlike perf counters, records
+    hold no import-time declarations — a fresh registry is safe)."""
+    with _LOCK:
+        _REG.clear()

@@ -55,11 +55,19 @@ class JitAccount:
     wrapped function's recompile granularity is not purely shape-based
     (e.g. a matrix passed as static content retraces per matrix);
     `span` overrides the span base name and `span_args(*args)` supplies
-    per-call span arguments."""
+    per-call span arguments; `exec_record` (obs.executables.ExecRecord)
+    links the wrapper to its entry in the executable registry, which
+    then receives the same compile/dispatch timings; `warm_hist` names
+    an additional quantile counter (declared here, shared across
+    wrappers) that receives ONLY warm dispatch times — several wrappers
+    feeding one logical distribution (every map_block dispatch,
+    whichever kernel serves it) without cold compiles polluting the
+    tail."""
 
     def __init__(
         self, fn, logger: PerfCounters, key: str,
         key_fn=None, span: str | None = None, span_args=None,
+        exec_record=None, warm_hist: str | None = None,
     ):
         self.fn = fn
         self.log = logger
@@ -67,6 +75,7 @@ class JitAccount:
         self.key_fn = key_fn
         self.span = span or f"{logger.name}.{key}"
         self.span_args = span_args
+        self.exec_record = exec_record
         self._seen: set[tuple] = set()
         logger.add_u64(f"{key}_compiles", "cold (trace+compile) calls")
         logger.add_u64(
@@ -82,6 +91,18 @@ class JitAccount:
         logger.add_time_avg(
             f"{key}_dispatch_seconds", "steady-state dispatch wall time"
         )
+        # tail latency, not just the mean: p50/p99 per dump
+        logger.add_quantile(
+            f"{key}_dispatch_hist",
+            "steady-state dispatch wall-time distribution",
+        )
+        self.warm_hist = warm_hist
+        if warm_hist:
+            logger.add_quantile(
+                warm_hist,
+                "steady-state dispatch wall-time distribution "
+                "(shared across kernels; cold compiles excluded)",
+            )
 
     def __call__(self, *args, **kw):
         sig = self.key_fn(*args) if self.key_fn else _sig(args)
@@ -101,16 +122,30 @@ class JitAccount:
         else:
             self.log.inc(f"{self.key}_cache_hits")
             self.log.observe(f"{self.key}_dispatch_seconds", dt)
+            self.log.observe(f"{self.key}_dispatch_hist", dt)
+            if self.warm_hist:
+                self.log.observe(self.warm_hist, dt)
+        if self.exec_record is not None:
+            self.exec_record.note_call(
+                dt, cold, args if cold else None, kw if cold else None
+            )
         return out
 
 
 def timed_fetch(logger: PerfCounters, key: str, x):
     """np.asarray(x) with the d2h transfer (which also forces completion
-    of the producing computation) booked into <key>_fetch_seconds."""
+    of the producing computation) booked into <key>_fetch_seconds, and
+    its distribution into the <key>_fetch_hist quantile counter."""
     name = f"{key}_fetch_seconds"
-    # declare-on-first-use: add_time_avg is idempotent, so re-declaring
+    hist = f"{key}_fetch_hist"
+    # declare-on-first-use: declares are idempotent, so re-declaring
     # on every call is safe (one lock acquisition, no state churn)
     logger.add_time_avg(name, "device->host transfer wall time")
+    logger.add_quantile(hist, "device->host transfer time distribution")
     with trace.span(f"{logger.name}.{key}.fetch"):
-        with logger.time(name):
-            return np.asarray(x)
+        t0 = time.perf_counter()
+        out = np.asarray(x)
+        dt = time.perf_counter() - t0
+    logger.observe(name, dt)
+    logger.observe(hist, dt)
+    return out

@@ -1,0 +1,87 @@
+"""Log-bucketed latency quantiles — the math behind the `quantile` kind.
+
+The serve-stage roadmap item needs p50/p99 tail latency, and the EC/
+load-balance literature the reproduction follows assumes per-dispatch
+latency *distributions*, not means (a mean hides exactly the tail a QPS
+target is written against).  A full reservoir per hot span is too
+expensive for dispatch paths that run tens of thousands of times per
+bench stage, so the perf registry grows a histogram-backed estimator:
+
+- observations land in log-spaced buckets (`DEFAULT_BOUNDS`: 1 µs to
+  100 s, 4 buckets per decade — one `observe()` is a short linear scan,
+  no allocation);
+- quantiles are estimated at *dump* time by walking the cumulative
+  histogram and interpolating geometrically inside the landing bucket
+  (the buckets are log-spaced, so log-linear interpolation is the
+  unbiased choice); the tracked min/max make the open-ended first and
+  overflow buckets exact at the ends.
+
+The estimate's error is bounded by the bucket ratio (10^(1/4) ≈ 1.78x
+worst case, far less in practice with interpolation) — plenty for
+regression detection, where the question is "did p99 double", not "is
+p99 1.03 ms or 1.04 ms".
+
+Import-light on purpose: `utils/perf_counters.py` (which must not drag
+jax or the obs package in) calls into this module lazily.
+"""
+
+from __future__ import annotations
+
+# 1 µs .. 100 s, 4 buckets per decade: 33 bounds -> 34 buckets.  Spans
+# everything between a single device enqueue and a deadline-killed stage.
+DEFAULT_BOUNDS: tuple[float, ...] = tuple(
+    10.0 ** (-6 + i / 4) for i in range(33)
+)
+
+#: the quantiles every `quantile`-kind counter reports in its dump
+REPORTED = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def estimate(
+    bounds, buckets, q: float,
+    vmin: float | None = None, vmax: float | None = None,
+) -> float:
+    """Estimate the q-quantile (0 < q < 1) of a histogram.
+
+    `bounds[i]` is the inclusive upper edge of bucket i; the final
+    bucket (`buckets[len(bounds)]`) is the overflow.  `vmin`/`vmax`
+    (tracked by the counter) tighten the open-ended first and last
+    buckets; without them the bucket edges bound the estimate.
+    """
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, n in enumerate(buckets):
+        if n == 0:
+            continue
+        if cum + n >= rank:
+            # edges of the landing bucket
+            if i == 0:
+                lo = vmin if vmin is not None else bounds[0] / 10.0
+                hi = bounds[0]
+            elif i == len(bounds):
+                lo = bounds[-1]
+                hi = vmax if vmax is not None else bounds[-1] * 10.0
+            else:
+                lo, hi = bounds[i - 1], bounds[i]
+            if vmin is not None:
+                lo = max(lo, min(vmin, hi))
+            if vmax is not None:
+                hi = min(hi, max(vmax, lo))
+            frac = (rank - cum) / n
+            if lo > 0 and hi > lo:
+                return lo * (hi / lo) ** frac  # log-linear: see module doc
+            return lo + (hi - lo) * frac
+        cum += n
+    # rank beyond the last populated bucket (fp rounding): the maximum
+    return vmax if vmax is not None else (bounds[-1] if bounds else 0.0)
+
+
+def summarize(bounds, buckets, vmin=None, vmax=None) -> dict[str, float]:
+    """The {p50, p90, p99} record embedded in a quantile counter dump."""
+    return {
+        name: estimate(bounds, buckets, q, vmin=vmin, vmax=vmax)
+        for name, q in REPORTED
+    }

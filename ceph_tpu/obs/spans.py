@@ -36,6 +36,9 @@ SPANS: dict[str, str] = {
     "bench.cold_pass": "first full mapping pass (includes compiles)",
     "bench.warm_pass": "steady-state full mapping pass",
     "bench.balancer": "balancer bench stage body",
+    "bench.diff": "bench-trajectory diff against a prior BENCH series",
+    # obs/ itself
+    "obs.exec_analyze": "executable-registry cost-analysis sweep",
     # balancer/
     "balancer.map_pool": "DeviceState full-pool mapping pass",
     "balancer.pgs_of": "device membership query for one OSD",

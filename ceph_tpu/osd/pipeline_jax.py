@@ -59,6 +59,11 @@ _L.add_u64("pipe_cache_hits",
            "PoolMapper constructions served by _PIPE_CACHE (no new jit)")
 _L.add_u64("pipe_cache_misses",
            "PoolMapper constructions that created a new jitted pipeline")
+_L.add_quantile("map_block_seconds",
+                "per-block map_block dispatch wall-time distribution "
+                "(warm dispatches only — cold compiles are booked into "
+                "*_compile_seconds, never into this tail; p50/p99 in "
+                "the dump)")
 
 
 def _h2(a, b):
@@ -529,8 +534,18 @@ class PoolMapper:
         acct = self._cache.get(kind)
         if acct is None:
             _L.inc("pipe_cache_misses")
+            jfn = jax.jit(jax.vmap(fn, in_axes=(0, None, 0)))
+            # every _PIPE_CACHE entry owns an executable-registry record:
+            # compile cost, hit counts, and lazy cost analysis ride there
+            rec = obs.executables.register(
+                "pipe", kind, getattr(fn, "cache_key", self.cache_key),
+                fn=jfn,
+            )
             acct = obs.JitAccount(
-                jax.jit(jax.vmap(fn, in_axes=(0, None, 0))), _L, kind,
+                jfn, _L, kind, exec_record=rec,
+                # the fast kernel IS the map_block dispatch; its warm
+                # calls feed the shared tail-latency distribution
+                warm_hist="map_block_seconds" if kind == "fast" else None,
             )
             self._cache[kind] = acct
         else:

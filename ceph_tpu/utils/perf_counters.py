@@ -6,7 +6,10 @@ Mirrors the reference's per-daemon counter surface
 <sock> perf dump` via the admin socket, reference
 src/common/admin_socket.cc).  Here: a registry of named counters with the
 same shapes, a `dump()` that matches the perf-dump JSON layout, and a
-`logger_for` helper the hot paths use.
+`logger_for` helper the hot paths use.  One kind is ours, not the
+reference's: `quantile` — a log-bucketed timing histogram whose dump
+carries estimated p50/p90/p99 (ceph_tpu.obs.quantiles), the tail-latency
+surface the serve-stage roadmap item budgets against.
 
 Declarations are idempotent (re-declaring a key with the same kind keeps
 the live counter — hot paths declare at import time and may be reloaded),
@@ -25,7 +28,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-KINDS = ("u64", "avg", "time_avg", "histogram")
+KINDS = ("u64", "avg", "time_avg", "histogram", "quantile")
 
 
 class UndeclaredCounterError(KeyError):
@@ -38,13 +41,17 @@ class CounterKindError(ValueError):
 
 @dataclass
 class _Counter:
-    kind: str  # u64 | avg | time_avg | histogram
+    kind: str  # u64 | avg | time_avg | histogram | quantile
     value: int = 0
     sum: float = 0.0
     count: int = 0
     buckets: list[int] = field(default_factory=list)
     bucket_bounds: list[float] = field(default_factory=list)
     desc: str = ""
+    # quantile kind only: observed extrema tighten the open-ended first
+    # and overflow buckets of the dump-time estimate
+    vmin: float = float("inf")
+    vmax: float = float("-inf")
 
 
 class _Timer:
@@ -119,6 +126,21 @@ class PerfCounters:
     ) -> None:
         self._declare(key, "histogram", desc, bounds=bounds)
 
+    def add_quantile(
+        self, key: str, desc: str = "", bounds: list[float] | None = None
+    ) -> None:
+        """A log-bucketed timing histogram whose dump carries estimated
+        p50/p90/p99 (see ceph_tpu.obs.quantiles).  Default bounds cover
+        1 µs .. 100 s at 4 buckets/decade; observe seconds into it
+        (observe()/time() both work)."""
+        if bounds is None:
+            # lazy: perf_counters must not import the obs package at
+            # module load (obs imports this module)
+            from ceph_tpu.obs.quantiles import DEFAULT_BOUNDS
+
+            bounds = list(DEFAULT_BOUNDS)
+        self._declare(key, "quantile", desc, bounds=bounds)
+
     def _get(self, key: str) -> _Counter:
         try:
             return self._c[key]
@@ -126,7 +148,7 @@ class PerfCounters:
             raise UndeclaredCounterError(
                 f"perf counter '{self.name}.{key}' is not declared "
                 "(declare it first with add_u64/add_avg/add_time_avg/"
-                "add_histogram)"
+                "add_histogram/add_quantile)"
             ) from None
 
     # -- updates -----------------------------------------------------------
@@ -155,14 +177,19 @@ class PerfCounters:
             c = self._get(key)
             if c.kind == "u64":
                 raise CounterKindError(
-                    f"perf counter '{self.name}.{key}' is u64; "
-                    "observe() needs avg/time_avg/histogram (use inc())"
+                    f"perf counter '{self.name}.{key}' is u64; observe() "
+                    "needs avg/time_avg/histogram/quantile (use inc())"
                 )
-            if c.kind == "histogram":
+            if c.kind in ("histogram", "quantile"):
                 i = 0
                 while i < len(c.bucket_bounds) and v > c.bucket_bounds[i]:
                     i += 1
                 c.buckets[i] += 1
+                if c.kind == "quantile":
+                    if v < c.vmin:
+                        c.vmin = v
+                    if v > c.vmax:
+                        c.vmax = v
             c.sum += v
             c.count += 1
 
@@ -188,12 +215,28 @@ class PerfCounters:
                         "sum": c.sum,
                         "avgtime": c.sum / c.count if c.count else 0.0,
                     }
-                else:
+                elif c.kind == "histogram":
                     out[key] = {
                         "bounds": c.bucket_bounds,
                         "buckets": list(c.buckets),
                         "sum": c.sum,
                         "count": c.count,
+                    }
+                else:  # quantile: histogram shape + dump-time estimates
+                    from ceph_tpu.obs.quantiles import summarize
+
+                    vmin = c.vmin if c.count else None
+                    vmax = c.vmax if c.count else None
+                    out[key] = {
+                        "bounds": c.bucket_bounds,
+                        "buckets": list(c.buckets),
+                        "sum": c.sum,
+                        "count": c.count,
+                        "min": 0.0 if vmin is None else vmin,
+                        "max": 0.0 if vmax is None else vmax,
+                        **summarize(
+                            c.bucket_bounds, c.buckets, vmin, vmax
+                        ),
                     }
         return out
 
@@ -213,6 +256,8 @@ class PerfCounters:
                 c.sum = 0.0
                 c.count = 0
                 c.buckets = [0] * len(c.buckets)
+                c.vmin = float("inf")
+                c.vmax = float("-inf")
 
 
 _registry: dict[str, PerfCounters] = {}

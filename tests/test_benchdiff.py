@@ -1,0 +1,233 @@
+"""benchdiff: the frozen BENCH fixture series (real r01-r05 rounds —
+including the genuine r02 gap — plus synthetic calibrated rounds with a
+seeded regression) loads without crashing, the r05-strategy calibration
+normalizes cross-container numbers, the seeded regressions are flagged,
+uncalibrated hardware deltas never flag, and the reports keep their
+shape.  The same fixture run is embedded in `bench.py --selftest`."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tools.benchdiff import (
+    SCHEMA_VERSION,
+    Round,
+    diff_series,
+    extract_metrics,
+    load_round,
+    load_series,
+    render_markdown,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "data" / "benchdiff"
+
+
+def fixture_rounds():
+    return load_series(sorted(FIXTURES.glob("*.json")))
+
+
+# -- loading ----------------------------------------------------------------
+
+def test_fixture_series_loads_with_gap_and_partial():
+    rounds = fixture_rounds()
+    names = [r.name for r in rounds]
+    # numeric rounds in order, non-numbered (the partial) after them
+    assert names[:8] == ["r01", "r02", "r03", "r04", "r05", "r06", "r07",
+                         "r08"]
+    assert names[-1] == "BENCH_partial"
+    by = {r.name: r for r in rounds}
+    assert by["r02"].empty and by["r02"].notes  # the real rc=1 round
+    assert not by["r05"].empty
+    assert by["BENCH_partial"].partial
+    # calibration only exists from the synthetic PR6-era rounds on
+    assert by["r05"].calibration is None
+    assert by["r07"].calibration == pytest.approx(0.078)
+
+
+def test_load_round_unreadable_is_a_gap(tmp_path):
+    p = tmp_path / "BENCH_r42.json"
+    p.write_text("{not json")
+    r = load_round(p)
+    assert r.empty and r.name == "r42" and r.notes
+
+
+def test_partial_checkpoint_folds_to_final_shape():
+    r = load_round(FIXTURES / "BENCH_partial.json")
+    assert r.partial and not r.empty
+    m = extract_metrics(r.record)
+    assert "configs.headline.mappings_per_sec" in m
+    # perf snapshot survives the fold: the balancer build-state time is
+    # extractable (the ROADMAP item-5 cost, tracked per round)
+    assert "perf.balancer.build_state_avgtime" in m
+
+
+def test_schema_version_future_round_noted():
+    r = Round("r99", {"schema_version": SCHEMA_VERSION + 1,
+                      "configs": {}})
+    assert any("newer bench" in n for n in r.notes)
+
+
+# -- diffing ----------------------------------------------------------------
+
+def test_seeded_regressions_flagged():
+    rep = diff_series(fixture_rounds())
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"] for d in rep["regressions"]}
+    assert {
+        "configs.headline.mappings_per_sec",  # throughput -47%
+        "configs.headline.jit.compiles",      # 0 -> 6: trace-once broken
+        "ec.rs84_encode_gbps_jax",            # EC encode -70%
+        "ec.trace_once_ok",                   # the stage's own proof bit
+        "quantiles.pipeline.map_block.p99",   # tail x4
+    } <= flagged
+    # every flagged throughput/tail metric compared on the same-machine
+    # calibration basis, not raw cross-container numbers
+    for d in rep["regressions"]:
+        if d["metric"] not in ("configs.headline.jit.compiles",
+                               "ec.trace_once_ok"):
+            assert d["normalized"], d
+
+
+def test_healthy_calibrated_rounds_are_clean():
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r06"], by["r07"]])
+    assert rep["verdict"] == "ok"
+    assert rep["regressions"] == []
+
+
+def test_gap_rounds_reported_never_fatal():
+    rep = diff_series(fixture_rounds())
+    assert any(g["round"] == "r02" for g in rep["gaps"])
+    # the gap contributes no deltas
+    assert not any("r02" in (d["from"], d["to"]) for d in rep["deltas"])
+
+
+def _mk(name, mps, cal):
+    rec = {"configs": {"headline": {"mappings_per_sec": mps}},
+           "ec": {"r05_strategy_gbps": cal} if cal else {}}
+    return Round(name, rec)
+
+
+def test_calibration_normalizes_cross_container():
+    # second container is exactly half as fast (calibration halves, raw
+    # throughput halves): normalized delta is zero -> clean
+    rep = diff_series([_mk("a", 60000.0, 0.16), _mk("b", 30000.0, 0.08)])
+    assert rep["verdict"] == "ok"
+    d = [x for x in rep["deltas"]
+         if x["metric"] == "configs.headline.mappings_per_sec"][0]
+    assert d["normalized"] and d["change"] == pytest.approx(0.0)
+
+
+def _mk_t(name, wall_s, cal):
+    rec = {"balancer": {"upmap": {"wall_s": wall_s}},
+           "ec": {"r05_strategy_gbps": cal} if cal else {}}
+    return Round(name, rec)
+
+
+def test_calibration_normalizes_time_metrics_inversely():
+    # time scales AGAINST machine speed: a half-speed container (half
+    # the calibration) legitimately takes 2x the wall clock — the
+    # normalized delta must be zero, not a 4x-amplified "regression"
+    rep = diff_series([_mk_t("a", 1.0, 0.16), _mk_t("b", 2.0, 0.08)])
+    assert rep["verdict"] == "ok"
+    d = [x for x in rep["deltas"]
+         if x["metric"] == "balancer.upmap.wall_s"][0]
+    assert d["normalized"] and d["change"] == pytest.approx(0.0)
+    # ...while the same slowdown on the SAME machine is a regression
+    rep = diff_series([_mk_t("a", 1.0, 0.16), _mk_t("b", 2.0, 0.16)])
+    assert rep["verdict"] == "regression"
+
+
+def test_uncalibrated_hardware_delta_never_flags():
+    # a 50% raw drop with no calibration anywhere: informational only
+    rep = diff_series([_mk("a", 60000.0, None), _mk("b", 30000.0, None)])
+    assert rep["verdict"] == "ok"
+    d = [x for x in rep["deltas"]
+         if x["metric"] == "configs.headline.mappings_per_sec"][0]
+    assert d.get("uncalibrated") and not d["normalized"]
+
+
+def test_same_machine_regression_flags():
+    rep = diff_series([_mk("a", 60000.0, 0.08), _mk("b", 30000.0, 0.08)])
+    assert rep["verdict"] == "regression"
+
+
+def test_compiles_from_zero_always_flag():
+    def mk(name, compiles):
+        return Round(name, {"configs": {"headline": {
+            "mappings_per_sec": 1000.0, "jit": {"compiles": compiles}}}})
+    rep = diff_series([mk("a", 0), mk("b", 1)], threshold=10.0)
+    assert [d["metric"] for d in rep["regressions"]] == [
+        "configs.headline.jit.compiles"]
+
+
+def test_timing_from_zero_is_noise_not_structural():
+    # bench rounds build_s to one decimal: 0.0 -> 0.1 on a timing
+    # metric is measurement noise, not the compiles-from-zero case
+    def mk(name, build_s):
+        return Round(name, {"rebalance": {"build_s": build_s},
+                            "ec": {"r05_strategy_gbps": 0.08}})
+    rep = diff_series([mk("a", 0.0), mk("b", 0.1)])
+    assert rep["verdict"] == "ok"
+
+
+def test_disappearing_metric_is_surfaced():
+    # a dropped guard metric (e.g. the jit section gone) must be
+    # visible in the report, not silently skipped
+    a = Round("a", {"configs": {"headline": {
+        "mappings_per_sec": 1000.0, "jit": {"compiles": 0}}}})
+    b = Round("b", {"configs": {"headline": {
+        "mappings_per_sec": 1000.0}}})
+    rep = diff_series([a, b])
+    assert {"metric": "configs.headline.jit.compiles",
+            "from": "a", "to": "b"} in rep["missing"]
+    md = render_markdown(rep)
+    assert "disappeared between rounds" in md
+
+
+def test_threshold_configurable():
+    rounds = [_mk("a", 60000.0, 0.08), _mk("b", 50000.0, 0.08)]  # -17%
+    assert diff_series(rounds, threshold=0.10)["verdict"] == "regression"
+    assert diff_series(rounds, threshold=0.25)["verdict"] == "ok"
+
+
+# -- reports ----------------------------------------------------------------
+
+def test_markdown_report_shape():
+    rep = diff_series(fixture_rounds())
+    md = render_markdown(rep)
+    assert "verdict: **regression**" in md
+    assert "| r02 | - | - | - | GAP:" in md
+    assert "configs.headline.mappings_per_sec" in md
+    assert "uncalibrated" in md  # the informational-deltas footnote
+
+
+def test_json_report_round_trips():
+    rep = diff_series(fixture_rounds())
+    again = json.loads(json.dumps(rep))
+    assert again["verdict"] == "regression"
+    assert again["schema_version"] == SCHEMA_VERSION
+
+
+# -- CLI (subprocess; slow-marked for the tier-1 budget) --------------------
+
+@pytest.mark.slow
+def test_cli_over_fixtures_exits_one_on_regression():
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.benchdiff",
+         *sorted(str(p) for p in FIXTURES.glob("*.json")),
+         "--json", "-"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 1, out.stderr[-500:]
+    rep = json.loads(out.stdout)
+    assert rep["verdict"] == "regression"
+    assert time.time() - t0 < 60  # pure-JSON tool: no jax import cost

@@ -1,0 +1,282 @@
+"""Executable registry + quantile counters: records register at cache
+miss, call accounting splits compile from dispatch, JAX cost analysis is
+lazy/cached and budget-bounded, the dumps and Prometheus gauges keep
+their shape, the hot caches (_PIPE_CACHE) really register, and the
+quantile estimator is sane on known distributions."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu import obs
+from ceph_tpu.obs import executables, quantiles
+
+
+# -- quantile estimator (pure math) ----------------------------------------
+
+def test_estimate_interpolates_within_bucket():
+    bounds = [1.0, 10.0, 100.0]
+    # 10 observations, all in (1, 10]: p50 lands mid-bucket
+    p50 = quantiles.estimate(bounds, [0, 10, 0, 0], 0.5)
+    assert 1.0 < p50 < 10.0
+    # log-spaced buckets -> geometric midpoint, not arithmetic
+    assert p50 == pytest.approx(1.0 * (10.0 / 1.0) ** 0.5)
+
+
+def test_estimate_respects_min_max():
+    bounds = [1.0, 10.0]
+    assert quantiles.estimate(bounds, [5, 0, 0], 0.5, vmin=0.4,
+                              vmax=0.6) <= 1.0
+    # overflow bucket clamps to the observed max
+    v = quantiles.estimate(bounds, [0, 0, 4], 0.99, vmax=42.0)
+    assert 10.0 < v <= 42.0
+
+
+def test_estimate_empty_histogram_is_zero():
+    assert quantiles.estimate([1.0], [0, 0], 0.5) == 0.0
+
+
+def test_quantile_counter_dump_and_reset():
+    L = obs.logger_for("t_exec_q")
+    L.add_quantile("lat", "latencies")
+    rng = np.random.default_rng(1)
+    vals = rng.lognormal(mean=np.log(1e-3), sigma=0.6, size=3000)
+    for v in vals:
+        L.observe("lat", float(v))
+    d = obs.perf_dump()["t_exec_q"]["lat"]
+    assert d["count"] == 3000
+    assert d["min"] <= d["p50"] <= d["p90"] <= d["p99"] <= d["max"]
+    # the estimator tracks the true quantiles within a bucket ratio
+    true = np.quantile(vals, [0.5, 0.99])
+    assert d["p50"] == pytest.approx(true[0], rel=0.8)
+    assert d["p99"] == pytest.approx(true[1], rel=0.8)
+    assert obs.perf_schema()["t_exec_q"]["lat"]["type"] == "quantile"
+    from ceph_tpu.utils import perf_counters as pc
+    pc.reset_values()
+    d = obs.perf_dump()["t_exec_q"]["lat"]
+    assert d["count"] == 0 and d["p50"] == 0.0 and d["min"] == 0.0
+
+
+def test_time_context_manager_feeds_quantile():
+    L = obs.logger_for("t_exec_q2")
+    L.add_quantile("span_t", "timed spans")
+    with L.time("span_t"):
+        pass
+    d = obs.perf_dump()["t_exec_q2"]["span_t"]
+    assert d["count"] == 1 and d["p50"] > 0
+
+
+# -- registry records -------------------------------------------------------
+
+def _small_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return (x.astype(jnp.uint32) * 3 + 1).sum()
+
+    return f
+
+
+def test_register_dedupes_on_structural_key():
+    key = ("t_exec", "dedupe", 1)
+    a = executables.register("ec", "xor", key)
+    b = executables.register("ec", "xor", key)
+    assert a is b
+
+
+def test_wrap_books_compile_then_dispatch_and_analyzes():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "wrapped", 2))
+    x = jnp.ones((4, 1024), jnp.uint8)
+    fn(x)
+    fn(x)
+    fn(x)
+    rec = fn.rec
+    assert rec.compiles == 1 and rec.hits == 2
+    assert rec.compile_seconds > 0
+    cost = rec.analyze()
+    assert cost and "error" not in cost
+    assert cost["flops"] > 0 and cost["bytes_accessed"] > 0
+    assert rec.analyze() is cost  # cached, not recomputed
+    e = rec.summary(analyze=True)
+    assert e["cache"] == "ec" and e["kind"] == "xor"
+    assert e["roofline"]["dispatch_avg_s"] >= 0
+
+
+def test_wrap_new_shape_is_a_new_compile():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "shapes", 3))
+    fn(jnp.ones((2, 64), jnp.uint8))
+    fn(jnp.ones((2, 128), jnp.uint8))  # retrace: booked as compile
+    assert fn.rec.compiles == 2 and fn.rec.hits == 0
+
+
+def test_dump_shape_and_cached_cost_rides_cheap_dumps():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "dump", 4))
+    fn(jnp.ones((2, 64), jnp.uint8))
+    fn(jnp.ones((2, 64), jnp.uint8))
+    d = executables.dump(analyze=False)
+    assert json.loads(json.dumps(d)) == d  # JSON-clean
+    assert d["by_cache"].get("ec", 0) >= 1
+    e = [x for x in d["entries"] if x["key"] == fn.rec.key_digest][0]
+    for field in ("cache", "kind", "cache_key", "compiles",
+                  "compile_seconds", "hits", "last_use_unix", "cost"):
+        assert field in e
+    # analyze=False never computed a cost for a fresh record
+    assert e["cost"] is None
+    # after a targeted analyze, the cached cost (and roofline) ride
+    # every later no-work dump — the admin-socket perf-dump path
+    cost = fn.rec.analyze()
+    assert cost and cost["flops"] > 0
+    e2 = [x for x in executables.dump(analyze=False)["entries"]
+          if x["key"] == fn.rec.key_digest][0]
+    assert e2["cost"]["flops"] > 0
+    assert "dispatch_avg_s" in e2["roofline"]
+    # memory analysis is opt-in (it compiles): "full" adds peak temp
+    full = fn.rec.analyze(memory=True)
+    assert "peak_temp_bytes" in full
+
+
+def test_jitaccount_feeds_exec_record():
+    import jax.numpy as jnp
+
+    raw = _small_jit()
+    rec = executables.register("bench", "stats", ("t_exec", "acct", 5),
+                               fn=raw)
+    acct = obs.JitAccount(raw, obs.logger_for("t_exec_acct"), "k",
+                          exec_record=rec)
+    x = jnp.ones((2, 32), jnp.uint8)
+    acct(x)
+    acct(x)
+    assert rec.compiles == 1 and rec.hits == 1
+    assert rec.analyze()["flops"] > 0
+
+
+def test_prometheus_gauges_shape():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "gauges", 6))
+    fn(jnp.ones((2, 64), jnp.uint8))
+    text = executables.prometheus_gauges()
+    assert '# TYPE ceph_tpu_executables_registered gauge' in text
+    assert 'ceph_tpu_executables_registered{cache="ec"}' in text
+    assert text.endswith("\n")
+
+
+# -- the hot caches really register ----------------------------------------
+
+def test_pipe_cache_registers_and_quantiles_advance():
+    from ceph_tpu.osd.osdmap import build_hierarchical
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+    from ceph_tpu.osd.types import PgPool, PoolType
+
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=97, pgp_num=97)
+    m = build_hierarchical(2, 8, n_rack=1, pool=pool)
+    before = obs.perf_dump()["pipeline"]["map_block_seconds"]["count"]
+    pm = PoolMapper(m, 0, overlays=False)
+    pm.map_batch(np.arange(97, dtype=np.uint32))  # cold: compile only
+    mb = obs.perf_dump()["pipeline"]["map_block_seconds"]
+    assert mb["count"] == before  # cold calls never pollute the tail
+    pm.map_batch(np.arange(97, dtype=np.uint32))  # warm dispatch
+    d = executables.dump(analyze=False)
+    assert any(e["cache"] == "pipe" and e["kind"] == "fast"
+               for e in d["entries"])
+    # the map_block dispatch quantile advanced and estimates a tail
+    mb = obs.perf_dump()["pipeline"]["map_block_seconds"]
+    assert mb["count"] > before
+    assert mb["p99"] >= mb["p50"] > 0
+    # THE pipe entry this mapper just dispatched cost-analyzes (the
+    # selftest acceptance path) — targeted, not a whole-registry sweep
+    # (a full test session registers dozens of big kernels)
+    rec = max(executables.records("pipe", "fast"),
+              key=lambda r: r.last_use)
+    cost = rec.analyze()
+    assert cost and "error" not in cost and cost["flops"] > 0
+
+
+def test_memory_analysis_attempted_at_most_once():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "memonce", 7))
+    fn(jnp.ones((2, 32), jnp.uint8))
+    cost = fn.rec.analyze(memory=True)
+    assert fn.rec._mem_tried
+    # even if the backend yielded no memory stats (simulated by
+    # dropping the key), the attempt counts: a "full" dump must not
+    # re-pay the lower+compile forever
+    cost.pop("peak_temp_bytes", None)
+    assert not fn.rec.analysis_pending(memory=True)
+    assert fn.rec.analyze(memory=True) is cost
+
+
+def test_failed_memory_pass_keeps_good_cached_cost():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "clobber", 9))
+    fn(jnp.ones((2, 32), jnp.uint8))
+    cost = fn.rec.analyze()
+    assert cost["flops"] > 0
+
+    class _Wedged:
+        def lower(self, *a, **kw):
+            raise RuntimeError("device wedged")
+
+    fn.rec._fn = _Wedged()  # the later "full" pass hits a dead device
+    out = fn.rec.analyze(memory=True)
+    assert out["flops"] > 0 and "error" not in out  # good data kept
+    assert fn.rec._mem_tried  # ...and the attempt still counted
+
+
+def test_dump_budget_bounds_work_before_it_starts():
+    import jax.numpy as jnp
+
+    fn = executables.wrap(_small_jit(), "ec", "xor",
+                          ("t_exec", "budget", 8))
+    fn(jnp.ones((2, 32), jnp.uint8))
+    # pretend this executable took a big-kernel compile: the estimated
+    # re-lower cost exceeds the whole budget, so a prompt diagnostic
+    # dump must skip it rather than stall on it
+    fn.rec.compile_seconds = 60.0
+    e = [x for x in executables.dump(analyze=True, budget_s=5.0)["entries"]
+         if x["key"] == fn.rec.key_digest][0]
+    assert e["cost"] is None
+    # cached results are served for free regardless of the estimate
+    fn.rec.analyze()
+    e = [x for x in executables.dump(analyze=True, budget_s=5.0)["entries"]
+         if x["key"] == fn.rec.key_digest][0]
+    assert e["cost"] and e["cost"]["flops"] > 0
+
+
+def test_admin_socket_commands_expose_registry():
+    from ceph_tpu.obs.admin_socket import handle_command
+    from ceph_tpu.obs.prometheus import prometheus_text
+
+    d = json.loads(handle_command("perf dump"))
+    assert "executables" in d and "entries" in d["executables"]
+    c = json.loads(handle_command("cache dump"))
+    assert "entries" in c and "by_cache" in c
+    assert "cache dump" in json.loads(handle_command("help"))
+    # a SAVED perf-dump reply renders offline: the embedded executables
+    # section (dicts/lists, not counters) must be skipped, not guessed
+    # into a summary shape that KeyErrors
+    text = prometheus_text(d, schema={})
+    assert "ceph_tpu_pipeline" in text or "ceph_tpu_ec" in text
+    # the registry section has its own gauge exposition; its scalar
+    # fields must not leak bogus counter series into the render
+    assert "ceph_tpu_executables_cost_analyzed" not in text

@@ -193,6 +193,21 @@ def test_counter_decl_dynamic_suffix_family(tmp_path):
     assert len(v) == 1 and v[0].line == 6
 
 
+def test_counter_decl_knows_quantile_kind(tmp_path):
+    # add_quantile is a declare like the other four kinds: updates on a
+    # quantile key resolve, a typo'd key still fires
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "L = obs.logger_for('fixg')\n"
+        "L.add_quantile('lat_hist', 'tails')\n"
+        "with L.time('lat_hist'):\n"
+        "    pass\n"
+        "L.observe('lat_hist', 0.5)\n"
+        "L.observe('lat_mist', 0.5)\n"
+    ), "counter-decl")
+    assert len(v) == 1 and v[0].line == 7 and "'lat_mist'" in v[0].message
+
+
 def test_counter_decl_observe_and_time(tmp_path):
     v = lint(tmp_path, (
         "from ceph_tpu import obs\n"

@@ -218,9 +218,62 @@ def test_balancer_counters_advance():
 # -- Prometheus text exposition --------------------------------------------
 
 _METRIC_LINE = re.compile(
-    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{le=\"[^\"]+\"\})? (-?\d+(\.\d+)?"
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[a-zA-Z_]+=\"[^\"]+\"\})? (-?\d+(\.\d+)?"
     r"(e[+-]?\d+)?|NaN)$"
 )
+
+
+def test_prometheus_exposition_golden():
+    """Exact exposition for every declared kind — u64/avg/time_avg/
+    histogram/quantile — with cumulative `_bucket` ordering and `+Inf`.
+    Kinds come from the declaration schema, never from duck-typing the
+    dump (quantile and histogram dumps share a shape)."""
+    from ceph_tpu.obs.prometheus import prometheus_text
+
+    L = obs.logger_for("t_gold")
+    L.add_u64("ops", "op count")
+    L.add_avg("batch", "batch sizes")
+    L.add_time_avg("lat", "latency")
+    L.add_histogram("sz", [1.0, 10.0, 100.0], "sizes")
+    L.add_quantile("ql", "dispatch latencies", bounds=[0.25, 2.0, 16.0])
+    L.inc("ops", 3)
+    L.observe("batch", 4.0)
+    L.observe("batch", 6.0)
+    L.observe("lat", 0.25)
+    for v in (0.5, 5.0, 50.0, 500.0):  # one per bucket incl. overflow
+        L.observe("sz", v)
+    for v in (0.125, 0.5, 0.5, 4.0, 32.0):
+        L.observe("ql", v)
+    text = prometheus_text({"t_gold": obs.perf_dump()["t_gold"]})
+    assert text == (
+        "# HELP ceph_tpu_t_gold_batch batch sizes\n"
+        "# TYPE ceph_tpu_t_gold_batch summary\n"
+        "ceph_tpu_t_gold_batch_sum 10.0\n"
+        "ceph_tpu_t_gold_batch_count 2\n"
+        "# HELP ceph_tpu_t_gold_lat latency\n"
+        "# TYPE ceph_tpu_t_gold_lat summary\n"
+        "ceph_tpu_t_gold_lat_sum 0.25\n"
+        "ceph_tpu_t_gold_lat_count 1\n"
+        "# HELP ceph_tpu_t_gold_ops op count\n"
+        "# TYPE ceph_tpu_t_gold_ops counter\n"
+        "ceph_tpu_t_gold_ops 3\n"
+        "# HELP ceph_tpu_t_gold_ql dispatch latencies\n"
+        "# TYPE ceph_tpu_t_gold_ql histogram\n"
+        'ceph_tpu_t_gold_ql_bucket{le="0.25"} 1\n'
+        'ceph_tpu_t_gold_ql_bucket{le="2.0"} 3\n'
+        'ceph_tpu_t_gold_ql_bucket{le="16.0"} 4\n'
+        'ceph_tpu_t_gold_ql_bucket{le="+Inf"} 5\n'
+        "ceph_tpu_t_gold_ql_sum 37.125\n"
+        "ceph_tpu_t_gold_ql_count 5\n"
+        "# HELP ceph_tpu_t_gold_sz sizes\n"
+        "# TYPE ceph_tpu_t_gold_sz histogram\n"
+        'ceph_tpu_t_gold_sz_bucket{le="1.0"} 1\n'
+        'ceph_tpu_t_gold_sz_bucket{le="10.0"} 2\n'
+        'ceph_tpu_t_gold_sz_bucket{le="100.0"} 3\n'
+        'ceph_tpu_t_gold_sz_bucket{le="+Inf"} 4\n'
+        "ceph_tpu_t_gold_sz_sum 555.5\n"
+        "ceph_tpu_t_gold_sz_count 4\n"
+    )
 
 
 def test_prometheus_text_valid():

@@ -1,0 +1,461 @@
+"""benchdiff — the BENCH_r*.json trajectory differ.
+
+Five bench rounds and a partial sat on disk with nothing reading them:
+the perf trajectory of the repo was unobservable, and regressions were
+caught by humans eyeballing PROFILE_*.md.  This tool loads a series of
+bench outputs, normalizes cross-container numbers, computes per-stage
+deltas, and flags regressions beyond configurable thresholds — as a
+library (bench.py `--diff-against`, the selftest fixture gate), and as a
+CLI emitting markdown and JSON reports:
+
+    python -m tools.benchdiff                      # repo BENCH series
+    python -m tools.benchdiff a.json b.json --json - --threshold 0.2
+
+Input tolerance (the real series is messy, by design of the exercise):
+
+- wrapper records ({"cmd", "rc", "parsed", ...}) — the retrieval shape
+  the committed BENCH_r*.json rounds use; an empty/failed `parsed`
+  (r02 really is one) becomes a *gap*, reported but never fatal;
+- final bench JSON (has "metric"/"configs");
+- BENCH_partial.json checkpoint shape (has "stages_done") — stage
+  records are folded into the final-JSON shape, perf snapshot kept.
+
+Cross-container normalization: absolute numbers from different
+containers are incomparable (r05's jax EC ran 0.153 GB/s on a fast host;
+the r07 container runs r05's exact code path at 0.078).  Every round
+since PR 6 therefore carries `ec.r05_strategy_gbps` — a same-machine
+measurement of one frozen code path.  Hardware-sensitive metrics are
+divided by `cal(round)/cal(reference)` before comparison; rounds without
+the calibration (r01–r05) still diff, but their hardware-sensitive
+deltas are recorded as informational (`uncalibrated`) and never flagged
+— a slower container is not a regression.  Structural metrics (jit
+compiles, pipe-cache hits, trace_once_ok) compare raw everywhere.
+
+`schema_version`: bench.py stamps the records it writes (current: 2);
+this reader accepts <= SCHEMA_VERSION and marks newer rounds with a
+note instead of guessing at fields it does not know.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+# the BENCH record shape version bench.py writes and this reader speaks.
+# v1: everything before the stamp existed (r01..r07-era records).
+# v2: adds schema_version, executables, quantiles, benchdiff sections.
+SCHEMA_VERSION = 2
+
+_ROUND_RE = re.compile(r"r(\d+)")
+
+# default regression threshold: relative change in the bad direction
+DEFAULT_THRESHOLD = 0.10
+
+
+class Round:
+    """One loaded bench round, normalized to the final-JSON shape."""
+
+    def __init__(self, name: str, record: dict, path: str | None = None,
+                 partial: bool = False):
+        self.name = name
+        self.path = path
+        self.record = record or {}
+        self.partial = partial
+        self.empty = not self.record
+        self.schema_version = int(self.record.get("schema_version", 1))
+        self.notes: list[str] = []
+        if self.schema_version > SCHEMA_VERSION:
+            self.notes.append(
+                f"written by a newer bench (schema_version="
+                f"{self.schema_version} > supported {SCHEMA_VERSION}); "
+                "unknown fields ignored"
+            )
+
+    @property
+    def calibration(self) -> float | None:
+        """The same-machine r05-strategy GB/s this round measured."""
+        cal = (self.record.get("ec") or {}).get("r05_strategy_gbps")
+        try:
+            cal = float(cal)
+        except (TypeError, ValueError):
+            return None
+        return cal if cal > 0 else None
+
+
+def _from_partial(raw: dict) -> dict:
+    """Fold a BENCH_partial.json checkpoint into the final-JSON shape."""
+    rec: dict = {"partial": True}
+    configs = {}
+    for key in ("crushtool_1k_32", "testmappgs_100k_1k", "headline"):
+        st = raw.get(key)
+        if isinstance(st, dict):
+            configs[key] = {k: v for k, v in st.items() if k != "perf"}
+    if configs:
+        rec["configs"] = configs
+    ec: dict = {}
+    for key in ("ec_jax", "ec_native", "ec_clay"):
+        st = raw.get(key)
+        if isinstance(st, dict):
+            ec.update({k: v for k, v in st.items() if k != "perf"})
+    if ec:
+        rec["ec"] = ec
+    for key in ("balancer", "rebalance", "executables", "quantiles",
+                "schema_version"):
+        if key in raw:
+            rec[key] = raw[key]
+    init = raw.get("init") or {}
+    if init:
+        rec["backend"] = init.get("backend")
+    if "perf" in raw:
+        rec["perf"] = raw["perf"]
+    head = configs.get("headline") or {}
+    if "mappings_per_sec" in head:
+        rec["value"] = head["mappings_per_sec"]
+    return rec
+
+
+def load_round(path: str | Path) -> Round:
+    p = Path(path)
+    m = _ROUND_RE.search(p.stem)
+    name = f"r{int(m.group(1)):02d}" if m else p.stem
+    try:
+        raw = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        r = Round(name, {}, str(p))
+        r.notes.append(f"unreadable: {type(e).__name__}: {e}"[:120])
+        return r
+    if not isinstance(raw, dict):
+        r = Round(name, {}, str(p))
+        r.notes.append("not a JSON object")
+        return r
+    if "parsed" in raw:  # retrieval wrapper
+        rec = raw.get("parsed") or {}
+        r = Round(name, rec if isinstance(rec, dict) else {}, str(p))
+        if r.empty:
+            r.notes.append(
+                f"round produced no parseable output (rc={raw.get('rc')})"
+            )
+        return r
+    if "stages_done" in raw:  # checkpoint shape
+        return Round(name, _from_partial(raw), str(p), partial=True)
+    return Round(name, raw, str(p))
+
+
+def load_series(paths) -> list[Round]:
+    """Load rounds, ordered by round number (non-numbered files keep
+    their given position after the numbered ones)."""
+    rounds = [load_round(p) for p in paths]
+
+    def key(item):
+        i, r = item
+        m = _ROUND_RE.search(r.name)
+        return (0, int(m.group(1)), i) if m else (1, 0, i)
+
+    return [r for _, r in sorted(enumerate(rounds), key=lambda t: key(t))]
+
+
+def default_series_paths(root: str | Path = ".") -> list[Path]:
+    root = Path(root)
+    out = sorted(root.glob("BENCH_r*.json"))
+    partial = root / "BENCH_partial.json"
+    if partial.exists():
+        out.append(partial)
+    return out
+
+
+# -- metric extraction ------------------------------------------------------
+# (name, value, higher_is_better, hardware_sensitive) per round
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
+    out: dict[str, tuple[float, bool, bool]] = {}
+
+    def put(name, v, up, cal):
+        v = _num(v)
+        if v is not None:
+            out[name] = (float(v), up, cal)
+
+    for cname, cfg in (rec.get("configs") or {}).items():
+        if not isinstance(cfg, dict):
+            continue
+        put(f"configs.{cname}.mappings_per_sec",
+            cfg.get("mappings_per_sec"), True, True)
+        put(f"configs.{cname}.cold_s", cfg.get("cold_s"), False, True)
+        jit = cfg.get("jit") or {}
+        put(f"configs.{cname}.jit.compiles", jit.get("compiles"),
+            False, False)
+        put(f"configs.{cname}.jit.pipe_cache_hits",
+            jit.get("pipe_cache_hits"), True, False)
+    ec = rec.get("ec") or {}
+    for k, v in ec.items():
+        # rs84_encode_gbps_jax, clay84_repair_gbps, batch rates, ... —
+        # everything measured in GB/s except the calibration itself
+        if "_gbps" in k and k != "r05_strategy_gbps":
+            put(f"ec.{k}", v, True, True)
+    if isinstance(ec.get("trace_once_ok"), bool):
+        # booleans ride as 0/1 structural metrics: True->False flags
+        out["ec.trace_once_ok"] = (float(ec["trace_once_ok"]), True, False)
+    bal = rec.get("balancer") or {}
+    for mode in ("upmap", "crush_compat"):
+        mrec = bal.get(mode) or {}
+        put(f"balancer.{mode}.wall_s", mrec.get("wall_s"), False, True)
+        put(f"balancer.{mode}.eval_pgs_per_sec",
+            mrec.get("eval_pgs_per_sec"), True, True)
+        put(f"balancer.{mode}.jit.compiles",
+            (mrec.get("jit") or {}).get("compiles"), False, False)
+    rb = rec.get("rebalance") or rec.get("rebalance_10m_10k") or {}
+    put("rebalance.build_s", rb.get("build_s"), False, True)
+    rounds = rb.get("rounds") or []
+    if rounds and isinstance(rounds[0], dict):
+        put("rebalance.round0_wall_s", rounds[0].get("wall_s"),
+            False, True)
+    for span, q in (rec.get("quantiles") or {}).items():
+        if isinstance(q, dict):
+            put(f"quantiles.{span}.p50", q.get("p50"), False, True)
+            put(f"quantiles.{span}.p99", q.get("p99"), False, True)
+    bs = ((rec.get("perf") or {}).get("balancer") or {}).get(
+        "build_state_seconds")
+    if isinstance(bs, dict):
+        put("perf.balancer.build_state_avgtime", bs.get("avgtime"),
+            False, True)
+    return out
+
+
+# -- diffing ----------------------------------------------------------------
+
+def diff_series(rounds: list[Round],
+                threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Per-metric deltas between consecutive non-empty rounds, with
+    regressions/improvements beyond `threshold`.  Returns the JSON
+    report (see render_markdown for the human shape)."""
+    usable = [r for r in rounds if not r.empty]
+    gaps = [
+        {"round": r.name, "notes": r.notes}
+        for r in rounds if r.empty
+    ]
+    # reference calibration: the latest calibrated round — "would the
+    # series regress if every round had run on the newest container"
+    ref_cal = None
+    for r in reversed(usable):
+        if r.calibration:
+            ref_cal = r.calibration
+            break
+    per_round = []
+    metrics: list[dict] = []  # parallel to usable
+    for r in usable:
+        cal = r.calibration
+        factor = (cal / ref_cal) if (cal and ref_cal) else None
+        metrics.append({
+            "round": r.name, "factor": factor,
+            "values": extract_metrics(r.record),
+        })
+        per_round.append({
+            "round": r.name,
+            "path": r.path,
+            "partial": r.partial,
+            "schema_version": r.schema_version,
+            "backend": r.record.get("backend"),
+            "value": _num(r.record.get("value")),
+            "calibration_gbps": cal,
+            "notes": r.notes,
+        })
+    deltas, regressions, improvements, missing = [], [], [], []
+    for prev, cur in zip(metrics, metrics[1:]):
+        # a metric that disappears between rounds is surfaced, not
+        # silently skipped — a refactor that stops emitting the jit /
+        # trace_once_ok sections would otherwise remove exactly the
+        # structural guards this tool enforces.  Informational, not a
+        # verdict: real rounds legitimately gain/lose whole stages
+        # (r01 predates EC, deadline-killed partials lose stages).
+        for name in sorted(set(prev["values"]) - set(cur["values"])):
+            missing.append({
+                "metric": name, "from": prev["round"], "to": cur["round"],
+            })
+        for name, (v1, up, cal_sensitive) in cur["values"].items():
+            if name not in prev["values"]:
+                continue
+            v0 = prev["values"][name][0]
+            normalized = False
+            n0, n1 = v0, v1
+            if cal_sensitive:
+                if prev["factor"] and cur["factor"]:
+                    # project onto the reference machine: throughput
+                    # scales WITH machine speed (divide by the factor),
+                    # time scales AGAINST it (multiply) — dividing a
+                    # wall-clock by the factor would amplify the
+                    # hardware difference instead of removing it
+                    if up:
+                        n0, n1 = v0 / prev["factor"], v1 / cur["factor"]
+                    else:
+                        n0, n1 = v0 * prev["factor"], v1 * cur["factor"]
+                    normalized = True
+            change = (n1 - n0) / abs(n0) if n0 else (
+                0.0 if n1 == n0 else float("inf"))
+            d = {
+                "metric": name,
+                "from": prev["round"], "to": cur["round"],
+                "prev": v0, "cur": v1,
+                "change": round(change, 4) if change != float("inf")
+                else None,
+                "higher_is_better": up,
+                "normalized": normalized,
+            }
+            if cal_sensitive and not normalized:
+                d["uncalibrated"] = True
+            deltas.append(d)
+            bad = (change < -threshold) if up else (change > threshold)
+            good = (change > threshold) if up else (change < -threshold)
+            if n0 == 0 and n1 > 0:
+                # zero baseline: the relative change is undefined (inf),
+                # so the threshold cannot arbitrate.  A STRUCTURAL
+                # counter appearing from zero is meaningful either way
+                # (compiles 0 -> N breaks trace-once; cache hits 0 -> N
+                # is the win).  A measured hardware quantity is not:
+                # bench rounds timings (build_s to one decimal), so
+                # 0.0 -> 0.1 is rounding noise — informational only.
+                if cal_sensitive:
+                    bad = good = False
+                elif not up:
+                    bad, good = True, False
+                else:
+                    bad, good = False, True
+            if cal_sensitive and not normalized:
+                continue  # cross-container raw delta: informational
+            if bad:
+                regressions.append(d)
+            elif good:
+                improvements.append(d)
+    return {
+        "tool": "benchdiff",
+        "schema_version": SCHEMA_VERSION,
+        "threshold": threshold,
+        "rounds": per_round,
+        "gaps": gaps,
+        "calibration_ref_gbps": ref_cal,
+        "deltas": deltas,
+        "missing": missing,
+        "regressions": regressions,
+        "improvements": improvements,
+        "verdict": "regression" if regressions else "ok",
+    }
+
+
+def _pct(d: dict) -> str:
+    return "new" if d["change"] is None else f"{d['change'] * 100:+.1f}%"
+
+
+def render_markdown(report: dict) -> str:
+    lines = ["# benchdiff", ""]
+    lines.append(
+        f"verdict: **{report['verdict']}** "
+        f"({len(report['regressions'])} regression(s), "
+        f"{len(report['improvements'])} improvement(s), "
+        f"threshold ±{report['threshold'] * 100:.0f}%, "
+        f"calibration ref {report['calibration_ref_gbps']} GB/s)"
+    )
+    lines.append("")
+    lines.append("| round | backend | headline | calibration | notes |")
+    lines.append("|-------|---------|----------|-------------|-------|")
+    for r in report["rounds"]:
+        notes = "; ".join(r["notes"]) + (" (partial)" if r["partial"]
+                                         else "")
+        lines.append(
+            f"| {r['round']} | {r['backend'] or '?'} | "
+            f"{r['value'] if r['value'] is not None else '-'} | "
+            f"{r['calibration_gbps'] or '-'} | {notes.strip('; ')} |"
+        )
+    for r in report["gaps"]:
+        lines.append(
+            f"| {r['round']} | - | - | - | GAP: "
+            f"{'; '.join(r['notes'])} |"
+        )
+    for title, rows in (("Regressions", report["regressions"]),
+                        ("Improvements", report["improvements"])):
+        lines.append("")
+        lines.append(f"## {title}")
+        if not rows:
+            lines.append("none")
+            continue
+        lines.append("| metric | rounds | prev | cur | change | basis |")
+        lines.append("|--------|--------|------|-----|--------|-------|")
+        for d in rows:
+            basis = "normalized" if d["normalized"] else "raw"
+            lines.append(
+                f"| {d['metric']} | {d['from']}→{d['to']} | {d['prev']} "
+                f"| {d['cur']} | {_pct(d)} | {basis} |"
+            )
+    uncal = sum(1 for d in report["deltas"] if d.get("uncalibrated"))
+    if uncal:
+        lines.append("")
+        lines.append(
+            f"{uncal} hardware-sensitive delta(s) involved uncalibrated "
+            "rounds (no `ec.r05_strategy_gbps`) and were recorded as "
+            "informational only — cross-container raw numbers never flag."
+        )
+    gone = report.get("missing") or []
+    if gone:
+        names = sorted({m["metric"] for m in gone})
+        shown = ", ".join(f"`{n}`" for n in names[:5])
+        more = f" (+{len(names) - 5} more)" if len(names) > 5 else ""
+        lines.append("")
+        lines.append(
+            f"{len(gone)} metric(s) disappeared between rounds "
+            f"({shown}{more}) — check the `missing` list in the JSON "
+            "report if a guard metric (jit.compiles, trace_once_ok) is "
+            "among them."
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.benchdiff",
+        description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="BENCH json files (default: the repo's "
+                    "BENCH_r*.json + BENCH_partial.json)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative regression threshold (default 0.10)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the JSON report to PATH ('-' = stdout)")
+    ap.add_argument("--md", metavar="PATH",
+                    help="write the markdown report to PATH "
+                    "('-' = stdout, the default when --json is unset)")
+    args = ap.parse_args(argv)
+    paths = args.paths or default_series_paths(
+        Path(__file__).resolve().parents[1])
+    if not paths:
+        print("benchdiff: no BENCH files found", file=sys.stderr)
+        return 2
+    rounds = load_series(paths)
+    if not any(not r.empty for r in rounds):
+        print("benchdiff: every round is a gap (no parseable record)",
+              file=sys.stderr)
+        return 2
+    report = diff_series(rounds, threshold=args.threshold)
+    md = render_markdown(report)
+    wrote = False
+    for spec, text in ((args.json, json.dumps(report, indent=1)),
+                       (args.md, md)):
+        if not spec:
+            continue
+        wrote = True
+        if spec == "-":
+            print(text)
+        else:
+            Path(spec).write_text(text)
+    if not wrote:
+        print(md, end="")
+    return 1 if report["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,9 +13,10 @@ Resolution is alias-aware and group-scoped:
   or function scope; `logger_for` bare or attribute-qualified);
 - a module function whose body returns such a logger propagates the
   group to `_counters().inc(...)`-style call sites;
-- declares (`add_u64` / `add_avg` / `add_time_avg` / `add_histogram`)
-  with literal keys are collected per group ACROSS modules — bench.py
-  updating "pipeline" keys declared in pipeline_jax.py is fine;
+- declares (`add_u64` / `add_avg` / `add_time_avg` / `add_histogram` /
+  `add_quantile`) with literal keys are collected per group ACROSS
+  modules — bench.py updating "pipeline" keys declared in
+  pipeline_jax.py is fine;
 - f-string declares contribute their constant tail as a dynamic-suffix
   pattern (`JitAccount` declares `f"{key}_compiles"` etc.), matched by
   `endswith` for updates whose exact key cannot be known statically;
@@ -34,7 +35,8 @@ from tools.graftlint.engine import (
     Context, Module, Pass, Violation, register,
 )
 
-DECLARES = ("add_u64", "add_avg", "add_time_avg", "add_histogram")
+DECLARES = ("add_u64", "add_avg", "add_time_avg", "add_histogram",
+            "add_quantile")
 UPDATES = ("inc", "observe", "time", "set")
 
 
@@ -165,5 +167,6 @@ class CounterDeclPass(Pass):
                 m.rel, node.lineno, self.name,
                 f"counter update {meth}({key!r}) has no declaration in "
                 f"{scope} (UndeclaredCounterError at runtime; declare "
-                "with add_u64/add_avg/add_time_avg/add_histogram)",
+                "with add_u64/add_avg/add_time_avg/add_histogram/"
+                "add_quantile)",
             ))

@@ -63,7 +63,15 @@ def make_fn(m):
     from ceph_tpu.osd.pipeline_jax import PoolMapper
 
     pm = PoolMapper(m, 0, overlays=False)
-    fn = jax.jit(jax.vmap(pm._fast, in_axes=(0, None, 0)))
+    # deliberately NOT pm.jitted_fast(): the ablation sweep monkeypatches
+    # kernel internals without changing the structural cache_key, so the
+    # shared _PIPE_CACHE must never see these compiles.  They register
+    # under their own "probe" cache instead (ablation variants share the
+    # structural key, so their timings aggregate on one record).
+    fn = obs.executables.wrap(
+        jax.jit(jax.vmap(pm._fast, in_axes=(0, None, 0))),
+        "probe", "fast", pm._fast.cache_key,
+    )
     dev = jax.device_put(pm.dev)
     return pm, fn, dev
 
@@ -219,8 +227,8 @@ def main():
 
     import jax
     log(f"devices: {jax.devices()}")
-    from bench import _enable_compile_cache
-    _enable_compile_cache()
+    from ceph_tpu import runtime
+    runtime.prewarm_compile_cache()
 
     m = build_map(args.pgs, args.osds)
     res = {"pgs": args.pgs, "osds": args.osds,
@@ -237,8 +245,11 @@ def main():
             res["trace"] = probe_trace(m)
     # the probe drives PoolMapper kernels, so the pipeline perf group has
     # been advancing; ship it (and the span trace, if CEPH_TPU_TRACE is
-    # set) with the numbers
+    # set) with the numbers.  The executables section is the SAME code
+    # path bench.py's output uses (obs.executables.dump) — probe runs and
+    # bench runs dump one schema, no drift.
     res["perf"] = obs.perf_dump()
+    res["executables"] = obs.executables.dump(analyze=True)
     tp = obs.flush()
     if tp:
         res["span_trace"] = tp
