@@ -318,6 +318,33 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
     }
 
 
+def bench_diagnostics(m, n_pgs: int) -> dict:
+    """The BENCH `diagnostics` section: the placement flight-recorder
+    summary of the headline map (device-reduced retry histogram,
+    collision/rejection/bad-mapping tallies) PLUS the proof that
+    instrumenting observed nothing it changed — the default pipeline is
+    warmed, the instrumented (with_diag) variant is built and
+    dispatched, then the default path runs again and must book 0
+    compiles and map bit-identically (instrumentation is a static plan
+    fact with its own cache entry)."""
+    from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+    pm = PoolMapper(m, 0, overlays=False)
+    n = min(n_pgs, int(os.environ.get("BENCH_DIAG_PGS", 262_144)))
+    ps = np.arange(n, dtype=np.uint32)
+    base = pm.map_batch(ps)  # warm the default path
+    summary = pm.diagnose(ps, source="bench.headline")
+    jit0 = _jit_counters()
+    again = pm.map_batch(ps)
+    jd = _jit_delta(jit0)
+    summary["default_path_compiles"] = jd.get("compiles", -1)
+    summary["mapping_identical"] = bool(all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(base, again)
+    ))
+    return summary
+
+
 def bench_rebalance(n_pgs: int, n_osds: int, rounds: int,
                     remaining, handle=None) -> dict:
     """North-star sim (BASELINE config 5): build an n_pgs/n_osds map,
@@ -818,6 +845,7 @@ def worker() -> None:
         if ch:
             r["c_baseline_mps"] = round(ch, 1)
             r["vs_c"] = round(r["mappings_per_sec"] / ch, 3)
+        r["diagnostics"] = bench_diagnostics(mh, n)
         return r
 
     def balancer_stage(h):
@@ -934,6 +962,13 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
     q = _quantile_section(stages.get("perf") or {})
     if q:
         out["quantiles"] = q
+    # the placement flight-recorder section rides the headline map
+    # (schema v3); hoisted to the top level for benchdiff
+    for cname in ("headline", "testmappgs_100k_1k", "crushtool_1k_32"):
+        c = configs.get(cname)
+        if isinstance(c, dict) and "diagnostics" in c:
+            out["diagnostics"] = c.pop("diagnostics")
+            break
     if "rebalance" in stages:
         rb = _strip_perf(stages["rebalance"])
         key = "rebalance"
@@ -1241,6 +1276,21 @@ def selftest() -> int:
         if not (q.get("p50", 0) > 0 and q.get("p99", 0) > 0):
             problems.append(
                 "no p50/p99 for pipeline.map_block dispatch in the output")
+        # placement-diagnostics acceptance gate: the flight recorder
+        # must have seen real decisions, and instrumenting must have
+        # cost the default path nothing (0 compiles, identical bytes)
+        dg = out.get("diagnostics") or {}
+        if not sum(dg.get("tries_histogram") or []):
+            problems.append("diagnostics tries histogram empty or missing")
+        if dg.get("default_path_compiles") != 0:
+            problems.append(
+                "default path booked "
+                f"{dg.get('default_path_compiles')} compile(s) after the "
+                "instrumented variant was built (wanted 0)")
+        if not dg.get("mapping_identical"):
+            problems.append(
+                "default-path mapping not bit-identical after the "
+                "instrumented run")
     lint = _selftest_graftlint(problems)
     execs = _selftest_executables(out, problems)
     bdiff = _selftest_benchdiff(problems)
@@ -1254,6 +1304,12 @@ def selftest() -> int:
         "graftlint": lint,
         "executables": execs,
         "quantiles": out.get("quantiles"),
+        "diagnostics": {
+            k: v for k, v in (out.get("diagnostics") or {}).items()
+            if k in ("pgs", "bad_mappings", "retry_exhausted",
+                     "collisions", "diag_exact", "default_path_compiles",
+                     "mapping_identical")
+        } or None,
         "benchdiff": bdiff,
     }
     if problems:

@@ -14,8 +14,25 @@ Covers the reference tool's compile/decompile/build/test surface
     crushtool -i map --tree
     crushtool -i map --reweight-item name w -o out
 
-Extra (this framework): --backend selects the vmapped TPU kernel (default)
-or the pure-Python host mapper.
+Extra (this framework):
+
+    --backend jax|ref          vmapped TPU kernel (default) or the
+                               pure-Python host mapper
+    crushtool -i map explain <x>
+                               replay ONE placement through the
+                               instrumented host oracle: bucket
+                               descents, straw2 draw winners/losers,
+                               rejection reasons, per-step work vectors
+                               (honors --rule/--num-rep/--pool-id/-w;
+                               <x> may also be <pool>.<seed>, which
+                               sets --pool-id)
+    crushtool -i map --locate-divergence [--against other-map]
+                               run min-x..max-x through BOTH the
+                               device kernel (built from -i map) and
+                               the host oracle (walking --against, or
+                               the same map) and report the earliest
+                               choose step where they disagree — the
+                               jax-vs-host triage entry point
 """
 
 from __future__ import annotations
@@ -144,6 +161,92 @@ def print_tree(m: CrushMap, out=sys.stdout) -> None:
         walk(r, 0, None)
 
 
+def _pick_rule(m: CrushMap, cfg: TesterConfig) -> tuple[int, int]:
+    """(ruleno, numrep) for the single-placement commands: --rule wins,
+    else the first present rule; --num-rep wins, else the rule's
+    max_size (the tester's default numrep sweep upper bound)."""
+    ruleno = (
+        cfg.rule
+        if cfg.rule >= 0
+        else next((i for i, r in enumerate(m.rules) if r is not None), -1)
+    )
+    if not (0 <= ruleno < len(m.rules)) or m.rules[ruleno] is None:
+        raise SystemExit(f"rule {ruleno} dne")
+    nr = cfg.num_rep if cfg.num_rep >= 0 else m.rules[ruleno].max_size
+    return ruleno, nr
+
+
+def run_explain(m: CrushMap, cfg: TesterConfig, explain_x: str,
+                out=None) -> int:
+    """`crushtool -i map explain <x>`: replay one placement through the
+    instrumented host oracle and print the decision log."""
+    import numpy as np
+
+    from ceph_tpu.crush import explain as explain_mod
+
+    out = out if out is not None else sys.stdout
+    if "." in explain_x:
+        p, s = explain_x.split(".", 1)
+        cfg.pool_id, x = int(p), int(s)
+    else:
+        x = int(explain_x)
+    tester = CrushTester(m, cfg, out=out)
+    ruleno, nr = _pick_rule(m, cfg)
+    real_x = int(tester._real_xs(np.array([x], np.int64))[0])
+    ex = explain_mod.explain_seed(m, ruleno, real_x, nr, tester.weight)
+    if cfg.pool_id != -1:
+        ex.update(pool=cfg.pool_id, seed=x, pps=real_x,
+                  up=ex["result"], up_primary=(ex["result"] or [-1])[0])
+    out.write(explain_mod.render_text(ex, m.item_names))
+    return 0
+
+
+def run_divergence(m: CrushMap, cfg: TesterConfig,
+                   against_fn: str | None, out=None) -> int:
+    """`crushtool -i map --locate-divergence [--against other]`: device
+    kernel (from `m`) vs host oracle (walking `against`, default `m`)
+    over min-x..max-x; report the earliest differing choose step.
+    Returns 0 when every step agrees, 2 on a located divergence."""
+    import numpy as np
+
+    from ceph_tpu.utils import ensure_jax_backend
+
+    ensure_jax_backend()
+    from ceph_tpu.crush import explain as explain_mod
+
+    out = out if out is not None else sys.stdout
+    tester = CrushTester(m, cfg, out=out)
+    ruleno, nr = _pick_rule(m, cfg)
+    xs = tester._real_xs(
+        np.arange(cfg.min_x, cfg.max_x + 1, dtype=np.int64)
+    )
+    m_host = _read_map(against_fn) if against_fn else m
+    d = explain_mod.first_divergence(
+        m_host, tester.m_arrays(), ruleno, xs, nr, tester.weight
+    )
+    span = f"rule {ruleno} x {cfg.min_x}..{cfg.max_x} numrep {nr}"
+    if d is None:
+        print(f"no divergence: {span} agrees step-for-step", file=out)
+        return 0
+    print(f"DIVERGENCE: {span}", file=out)
+    print(
+        f"  first differing choose step: {d['step']} at x={d['x']} "
+        f"(batch index {d['batch_index']})",
+        file=out,
+    )
+    print(f"  jax:  {d['jax']}", file=out)
+    print(f"  host: {d['host']}", file=out)
+    print(
+        f"  {d['n_divergent']}/{d['n_checked']} seeds diverge "
+        f"({d['n_unresolved_skipped']} unresolved lanes host-rescued, "
+        "not compared)",
+        file=out,
+    )
+    print("host decision log for that seed:", file=out)
+    out.write(explain_mod.render_text(d["host_log"], m_host.item_names))
+    return 2
+
+
 def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     infn = None
@@ -153,6 +256,9 @@ def main(argv: list[str] | None = None) -> int:
     do_test = False
     do_tree = False
     do_build = False
+    explain_x: str | None = None
+    do_divergence = False
+    against_fn: str | None = None
     num_osds = 0
     layers: list[tuple[str, str, int]] = []
     cfg = TesterConfig()
@@ -180,6 +286,12 @@ def main(argv: list[str] | None = None) -> int:
             decompilefn = next_arg(a)
         elif a == "--test":
             do_test = True
+        elif a == "explain":
+            explain_x = next_arg(a)
+        elif a == "--locate-divergence":
+            do_divergence = True
+        elif a == "--against":
+            against_fn = next_arg(a)
         elif a == "--tree":
             do_tree = True
         elif a == "--build":
@@ -275,6 +387,10 @@ def main(argv: list[str] | None = None) -> int:
         m.build_class_shadow_trees()
         changed = True
 
+    if explain_x is not None:
+        return run_explain(m, cfg, explain_x)
+    if do_divergence:
+        return run_divergence(m, cfg, against_fn)
     if do_tree:
         print_tree(m)
     if do_test:

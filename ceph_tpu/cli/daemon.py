@@ -21,6 +21,10 @@ Commands (reference names):
     metrics       Prometheus text exposition (format 0.0.4)
     cache dump    executable registry with JAX cost/memory analysis
                   (flops, bytes accessed, peak temp memory, rooflines)
+    bad dump      placement-diagnostics snapshots (per-source bad
+                  mappings, retry histograms; ceph_tpu.obs.placement)
+    explain X.Y   host-oracle decision log for PG Y of pool X (the
+                  crushtool-explain replay, served for mapped pools)
     trace flush   write the Chrome trace-event file (CEPH_TPU_TRACE)
     runtime       backend-acquisition provenance (ceph_tpu.runtime:
                   backend, fallback_reason, attempts) + armed faults
@@ -83,9 +87,13 @@ def _selftest() -> None:
             type=PoolType.REPLICATED, size=3, crush_rule=0,
             pg_num=SELFTEST_PGS, pgp_num=SELFTEST_PGS,
         )
-        m = build_hierarchical(SELFTEST_OSDS // 8, 8, n_rack=1, pool=pool)
+        # 4 hosts so size-3 chooseleaf lanes resolve inside the fast
+        # window — `bad dump` then shows a real tries histogram instead
+        # of the all-flagged 2-host degenerate case
+        m = build_hierarchical(SELFTEST_OSDS // 4, 4, n_rack=1, pool=pool)
         pm = PoolMapper(m, 0, overlays=False)
         pm.map_batch(np.arange(SELFTEST_PGS, dtype=np.uint32))
+        pm.diagnose()  # populates `bad dump` + the explain registry
         log(5, f"selftest: mapped {SELFTEST_PGS} pgs")
 
         rs = create_erasure_code({"plugin": "jax", "k": "8", "m": "4"})
@@ -127,7 +135,8 @@ def main(argv: list[str] | None = None) -> int:
 
     # read-only commands benefit from a populated registry; mutating or
     # metadata commands run against the process as-is
-    if (cmd in ("perf dump", "perf schema", "metrics", "cache dump")
+    if ((cmd in ("perf dump", "perf schema", "metrics", "cache dump",
+                 "bad dump") or cmd.startswith("explain"))
             and not args.no_selftest):
         _selftest()
     print(asok.handle_command(cmd))

@@ -74,6 +74,24 @@ def size_histogram(rows, max_size: int, extra_mask=None, dtype=jnp.int64):
     ].add(1)
 
 
+def value_histogram(vals, max_value: int, extra_mask=None,
+                    dtype=jnp.int64):
+    """Histogram of small non-negative integer values over
+    [0, max_value]; negative lanes (the diagnostics planes' -1 = no
+    placement marker) fall off the end, and so do values above
+    max_value — the reference histogram only increments when
+    `ftotal <= len - 1` (mapper_ref.do_rule), so overflow is dropped,
+    not clamped, to stay bit-identical with host collection."""
+    valid = vals >= 0
+    if extra_mask is not None:
+        valid = valid & extra_mask
+    valid = valid & (vals <= max_value)
+    idx = jnp.where(valid, jnp.clip(vals, 0, max_value), max_value + 1)
+    return jnp.zeros(max_value + 2, dtype).at[idx.reshape(-1)].add(
+        1
+    )[: max_value + 1]
+
+
 def misplaced_lanes(before, after, extra_mask=None):
     """Count of occupied `after` lanes whose OSD is not a member of the
     same row in `before` — the replica-slot form of the reference's

@@ -1,0 +1,331 @@
+"""Explain replay + jax-vs-host first-divergence triage.
+
+Two halves of one debugging workflow:
+
+1. `explain_seed` / `explain_pool_pg`: replay ONE placement through the
+   instrumented host oracle (`mapper_ref.do_rule(recorder=...)`) and
+   return the full decision log — bucket descents, straw2 draw
+   winners/losers, collision / out-of-weight / skip rejections, leaf
+   recursions, per-step work vectors.  `render_text` formats it the way
+   `crushtool explain` prints it.
+
+2. `first_divergence`: run a BATCH of seeds through both the
+   instrumented device kernel (`compile_rule(with_diag=True)`, whose
+   `steps` plane records the work vector after every choose step) and
+   the host oracle, and pin any disagreement to the EARLIEST differing
+   choose step — the triage entry point when a tunable/port bug makes
+   the fused kernel drift from reference semantics.  The device side is
+   one vmapped dispatch; only the O(N·steps·width) step planes are
+   fetched, and only when the final results already disagree would a
+   human ever look further than the returned record.
+
+The device kernels land in mapper_jax._KERNEL_CACHE / the executable
+registry like every other trace-once entry point; instrumentation is a
+static plan fact, so building them never touches the default kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.crush import mapper_ref
+from ceph_tpu.crush.types import CrushMap, ITEM_NONE, RuleOp
+
+_OPS = {
+    int(RuleOp.CHOOSE_FIRSTN): "choose firstn",
+    int(RuleOp.CHOOSELEAF_FIRSTN): "chooseleaf firstn",
+    int(RuleOp.CHOOSE_INDEP): "choose indep",
+    int(RuleOp.CHOOSELEAF_INDEP): "chooseleaf indep",
+}
+
+
+class ExplainRecorder:
+    """Decision recorder the host oracle emits into (see
+    mapper_ref.do_rule).  `events` is the flat chronological log (each
+    dict carries the recursion `depth` it was emitted at); `steps` holds
+    the work vector after every choose step — the host half of the
+    first-divergence comparison.
+
+    detail=False skips the straw2 per-item draw dumps (the only
+    expensive payload) — what the batch locator uses."""
+
+    __slots__ = ("events", "steps", "depth", "detail")
+
+    def __init__(self, detail: bool = True):
+        self.events: list[dict] = []
+        self.steps: list[list[int]] = []
+        self.depth = 0
+        self.detail = detail
+
+    def emit(self, **kw) -> None:
+        kw["depth"] = self.depth
+        self.events.append(kw)
+
+    def step_result(self, w: list[int]) -> None:
+        self.steps.append(list(w))
+
+
+def explain_seed(
+    m: CrushMap,
+    ruleno: int,
+    x: int,
+    result_max: int,
+    weight: list[int],
+    choose_args=None,
+    detail: bool = True,
+) -> dict:
+    """Replay one mapping through the instrumented host oracle."""
+    rec = ExplainRecorder(detail=detail)
+    result = mapper_ref.do_rule(
+        m, ruleno, int(x), result_max, weight, choose_args, recorder=rec
+    )
+    return {
+        "x": int(x),
+        "ruleno": ruleno,
+        "result": [int(v) for v in result],
+        "steps": rec.steps,
+        "events": rec.events,
+    }
+
+
+def explain_pool_pg(m_osd, pool_id: int, seed: int) -> dict:
+    """Replay one PG of an OSDMap pool: the pipeline's stage-1 seed
+    mixing (ps -> pps) on the host, then the CRUSH walk — the payload
+    behind the daemon `explain <pool>.<seed>` command."""
+    from ceph_tpu.osd.types import PgId
+
+    pool = m_osd.pools.get(pool_id)
+    if pool is None:
+        return {"error": f"no pool {pool_id}"}
+    if not (0 <= seed < pool.pg_num):
+        return {"error": f"seed {seed} outside pg_num {pool.pg_num}"}
+    pps = pool.raw_pg_to_pps(PgId(pool_id, seed))
+    ruleno = mapper_ref.find_rule(
+        m_osd.crush, pool.crush_rule, int(pool.type), pool.size
+    )
+    ca = m_osd.crush.choose_args.get(
+        pool_id, m_osd.crush.choose_args.get(-1)
+    )
+    out = explain_seed(
+        m_osd.crush, ruleno, pps, pool.size, list(m_osd.osd_weight), ca
+    )
+    up, up_p, _, _ = m_osd.pg_to_up_acting_osds(PgId(pool_id, seed))
+    out.update(pool=pool_id, seed=seed, pps=int(pps),
+               up=[int(v) for v in up], up_primary=int(up_p))
+    return out
+
+
+def render_text(ex: dict, item_names: dict | None = None) -> str:
+    """Human formatting of an explain record (the `crushtool explain`
+    output): one line per decision, indented by recursion depth."""
+    if "error" in ex:
+        return f"explain: {ex['error']}\n"
+
+    def name(it):
+        if it is None:
+            return "?"
+        if item_names and it in item_names:
+            return f"{it} ({item_names[it]})"
+        return str(it)
+
+    lines = []
+    head = f"explain x={ex['x']} rule {ex['ruleno']}"
+    if "pool" in ex:
+        head = (f"explain pg {ex['pool']}.{ex['seed']} (pps={ex['pps']}) "
+                f"rule {ex['ruleno']}")
+    lines.append(head)
+    step = -1
+    for ev in ex["events"]:
+        pad = "  " * (ev.get("depth", 0) + 1)
+        kind = ev["ev"]
+        if kind == "take":
+            lines.append(f"{pad}take {name(ev['item'])}"
+                         + ("" if ev.get("valid", True) else " [invalid]"))
+        elif kind == "choose":
+            step += 1
+            op = _OPS.get(ev.get("op"), "choose")
+            lines.append(
+                f"{pad}step {step}: {op} numrep={ev['numrep']} "
+                f"type={ev['type']} from {ev['sources']}"
+            )
+        elif kind == "straw2":
+            order = sorted(ev["draws"], key=lambda d: -d[1])
+            top = ", ".join(
+                f"{name(it)}:{d}" for it, d in order[:3]
+            )
+            lines.append(
+                f"{pad}  straw2 bucket {ev['bucket']} r={ev['r']} -> "
+                f"{name(ev['winner'])}  [top draws: {top}]"
+            )
+        elif kind == "draw":
+            lines.append(
+                f"{pad}  rep {ev['rep']} r={ev['r']} ftotal={ev['ftotal']}"
+                f" bucket {ev['bucket']} -> {name(ev.get('item'))} "
+                f"[{ev['status']}]"
+            )
+        elif kind == "leaf_enter":
+            lines.append(f"{pad}  rep {ev['rep']}: descend to leaf in "
+                         f"bucket {ev['bucket']} (r={ev['r']})")
+        elif kind == "leaf_exit":
+            lines.append(f"{pad}  leaf descent "
+                         f"{'ok' if ev['ok'] else 'REJECTED'}")
+        elif kind == "place":
+            lines.append(
+                f"{pad}  PLACE rep {ev['rep']} -> {name(ev['item'])} "
+                f"(retries={ev['ftotal']}, slot {ev['outpos']})"
+            )
+        elif kind == "emit":
+            lines.append(f"{pad}emit -> {ev['result']}")
+    if "up" in ex:
+        lines.append(f"  up={ex['up']} primary={ex['up_primary']}")
+    else:
+        lines.append(f"  result={ex['result']}")
+    return "\n".join(lines) + "\n"
+
+
+# -- device side -----------------------------------------------------------
+
+def diag_batch(A, ruleno: int, result_max: int, window_extra=None):
+    """Memoized instrumented batch runner over one CrushArrays:
+    run(xs, dev_weights) -> (result, unresolved, diag) DEVICE arrays
+    (diag: tries [N, lanes], coll/rej/skip/bad [N], steps [N, S, RMAX]).
+    Mirrors mapper_jax.compile_batched's memo/cache discipline; the
+    jitted executable lands in _KERNEL_CACHE + the executable registry.
+    The returned runner carries the plan facts (`diag_exact`,
+    `diag_tries_bound`, `diag_steps`, `diag_lanes`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.mapper_jax import (
+        FAST_WINDOW_EXTRA, _KERNEL_CACHE, compile_rule, device_tables,
+    )
+    from ceph_tpu.obs import executables as _executables
+
+    if window_extra is None:
+        window_extra = FAST_WINDOW_EXTRA
+    memo = A.__dict__.get("_diag_batch_memo")
+    if memo is None:
+        memo = {}
+        object.__setattr__(A, "_diag_batch_memo", memo)  # frozen dataclass
+    mkey = (ruleno, result_max, window_extra)
+    if mkey in memo:
+        return memo[mkey]
+    fn = compile_rule(A, ruleno, result_max, with_flag=True,
+                      with_diag=True, window_extra=window_extra)
+    tables = device_tables(fn.host_tables)
+    fkey = ("batched_diag", fn.cache_key)
+    jfn = _KERNEL_CACHE.get(fkey)
+    if jfn is None:
+        jfn = _executables.wrap(
+            jax.jit(jax.vmap(fn, in_axes=(0, None, None))),
+            "kernel", "batched_diag", fkey,
+        )
+        _KERNEL_CACHE[fkey] = jfn
+
+    def run(xs, dev_weights):
+        return jfn(jnp.asarray(xs).astype(jnp.uint32),
+                   jnp.asarray(dev_weights).astype(jnp.uint32), tables)
+
+    run.diag_exact = fn.diag_exact
+    run.diag_tries_bound = fn.diag_tries_bound
+    run.diag_steps = fn.diag_steps
+    run.diag_lanes = fn.diag_lanes
+    run.cache_key = fn.cache_key
+    memo[mkey] = run
+    return run
+
+
+def device_choose_tries(A, ruleno: int, result_max: int, xs, weights,
+                        hist_len: int):
+    """The device half of the --show-choose-tries unification: the
+    per-placement retry histogram from the diagnostics planes, reduced
+    ON device (only the O(hist_len) counts and the unresolved flags are
+    fetched).  Returns (hist i64[hist_len], unresolved_idx i64[k]) —
+    flagged lanes carry garbage planes and are EXCLUDED; the caller
+    re-collects them through the host mapper (the same rescue contract
+    the mapping path uses).  Raises ValueError when the compiled plan
+    cannot reproduce the host increments (`diag_exact` False) — callers
+    fall back to full host collection."""
+    from ceph_tpu import obs
+    from ceph_tpu.core import reduce
+
+    run = diag_batch(A, ruleno, result_max)
+    if not run.diag_exact:
+        raise ValueError("plan is not diag-exact; use host collection")
+    with obs.span("crush.diag_batch", xs=len(np.asarray(xs))):
+        _, flg, diag = run(xs, weights)
+    hist = reduce.value_histogram(
+        diag["tries"], hist_len - 1, extra_mask=~flg[:, None]
+    )
+    hist_v = np.asarray(hist)
+    unresolved = np.nonzero(np.asarray(flg))[0]
+    return hist_v, unresolved
+
+
+def first_divergence(
+    m_host: CrushMap,
+    A,
+    ruleno: int,
+    xs,
+    result_max: int,
+    weights: list[int],
+    choose_args=None,
+) -> dict | None:
+    """Locate the earliest choose step where the device kernel (built
+    from `A`) and the host oracle (walking `m_host`) disagree, over a
+    batch of seeds.  Returns None when every step of every seed agrees;
+    otherwise a record naming the first divergent (step, x) with both
+    work vectors and the host decision log for that seed.
+
+    `m_host` and `A` are passed separately on purpose: triage compares
+    a device kernel against a DIFFERENT host map (perturbed tunables, a
+    candidate map edit) as readily as against its own source.  Lanes
+    the fast window flagged unresolved are skipped (production rescues
+    them exactly; their planes are garbage by contract)."""
+    xs = np.asarray(xs)
+    run = diag_batch(A, ruleno, result_max)
+    res_d, flg_d, diag = run(xs, np.asarray(weights, np.uint32))
+    steps_d = np.asarray(diag["steps"])      # [N, S, RMAX]
+    flg = np.asarray(flg_d)
+    S = steps_d.shape[1]
+
+    best: tuple[int, int] | None = None  # (step, batch index)
+    host_steps_at_best: list[list[int]] | None = None
+    n_divergent = 0
+    for b, x in enumerate(xs):
+        if flg[b]:
+            continue
+        rec = ExplainRecorder(detail=False)
+        mapper_ref.do_rule(m_host, ruleno, int(x), result_max,
+                           list(weights), choose_args, recorder=rec)
+        div_step = None
+        for s in range(S):
+            host = rec.steps[s] if s < len(rec.steps) else []
+            host_p = (host + [ITEM_NONE] * result_max)[:result_max]
+            if list(steps_d[b, s]) != host_p:
+                div_step = s
+                break
+        if div_step is None:
+            continue
+        n_divergent += 1
+        if best is None or div_step < best[0]:
+            best = (div_step, b)
+            host_steps_at_best = rec.steps
+    if best is None:
+        return None
+    s, b = best
+    host = host_steps_at_best[s] if s < len(host_steps_at_best) else []
+    return {
+        "step": s,
+        "x": int(xs[b]),
+        "batch_index": b,
+        "jax": [int(v) for v in steps_d[b, s]],
+        "host": (host + [ITEM_NONE] * result_max)[:result_max],
+        "n_divergent": n_divergent,
+        "n_checked": int(len(xs) - flg.sum()),
+        "n_unresolved_skipped": int(flg.sum()),
+        "host_log": explain_seed(
+            m_host, ruleno, int(xs[b]), result_max, list(weights),
+            choose_args,
+        ),
+    }

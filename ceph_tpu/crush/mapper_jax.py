@@ -1151,6 +1151,7 @@ def _choose_firstn_one_fast(
     leaf_bound: int | None = None,
     levels: list | None = None,
     leaf_levels: list | None = None,
+    with_diag: bool = False,
 ):
     """Vectorized crush_choose_firstn (same semantics as
     _choose_firstn_one; reference src/crush/mapper.c:460-648).
@@ -1210,6 +1211,14 @@ def _choose_firstn_one_fast(
     unresolved = jnp.bool_(False)
     sel_r = []  # per-rep selected r index (traced scalars)
     sel_ok = []
+    # diagnostics plane (with_diag): per-placement retry counts plus
+    # collision / out-of-weight / skip tallies, derived from the SAME
+    # window masks the selection walk already computes — the C attempts
+    # exactly the contiguous r-range up to its first success, so the
+    # draws "the C would have made" are reconstructible after the fact
+    # (mask algebra only; no extra descents)
+    tries_sel: list = []
+    d_coll = d_rej = d_skip = jnp.int32(0)
 
     # pass 1 — outer selection.  For chooseleaf the leaf descent is
     # DEFERRED: we optimistically select each rep's first outer-valid
@@ -1250,11 +1259,31 @@ def _choose_firstn_one_fast(
         out = out.at[safe].set(jnp.where(ok, cand[rstar], out[safe]))
         sel_r.append(rstar)
         sel_ok.append(ok)
+        if with_diag:
+            # draws the C actually attempts for this rep: the live window
+            # up to and including the success (or the first skip_rep);
+            # nothing is attempted once the count is exhausted or the
+            # source bucket was invalid (cnt starts at 0 then)
+            attempted = in_win & ~dead_before & (cnt > 0)
+            attempted = attempted & jnp.where(ok, rs <= rstar, True)
+            d_coll = d_coll + jnp.sum(
+                (attempted & collide).astype(jnp.int32))
+            d_rej = d_rej + jnp.sum(
+                (attempted & reject & found).astype(jnp.int32))
+            d_skip = d_skip + jnp.sum(
+                (attempted & win_skip).astype(jnp.int32))
+            # retry count at success == host ftotal (r = rep + ftotal)
+            tries_sel.append(
+                jnp.where(ok, rstar - rep, -1).astype(jnp.int32))
         outpos = outpos + jnp.where(ok, 1, 0)
         cnt = cnt - jnp.where(ok, 1, 0)
 
     if not leafy:
         # out2 mirrors out (devices/buckets chosen directly)
+        if with_diag:
+            dstep = {"tries": jnp.stack(tries_sel), "coll": d_coll,
+                     "rej": d_rej, "skip": d_skip}
+            return out, out, outpos, unresolved, dstep
         return out, out, outpos, unresolved
 
     # pass 2 — leaf descents for the selected candidates only
@@ -1281,7 +1310,8 @@ def _choose_firstn_one_fast(
                 lambda k: _descend(d, x, c, sr + k, 0, 0, leaf_bound)
             )(ks)
         )(sel_cand, sub_r)  # [numrep, Rt]
-    leaf_sel = (lstat == _FOUND) & ~_is_out(x, leaf, dev_weights, weight_max)
+    leaf_out = _is_out(x, leaf, dev_weights, weight_max)
+    leaf_sel = (lstat == _FOUND) & ~leaf_out
     leaf_skip = lstat == _SKIP
     # a leaf attempt aborts at the first _SKIP (C returns <= outpos)
     leaf_dead = (
@@ -1290,15 +1320,19 @@ def _choose_firstn_one_fast(
     ) > 0
     out2 = jnp.full(NR, ITEM_NONE, jnp.int32)
     pos2 = jnp.int32(0)
+    leaf_tries: list = []
     for rep in range(numrep):
         ok = sel_okv[rep]
         lgood = leaf_sel[rep] & ~leaf_dead[rep]
         if rep > 0:  # rep 0: out2/pos2 are constants (see pass-1 note)
-            lgood = lgood & ~jnp.any(
+            lcoll = jnp.any(
                 (leaf[rep][:, None] == out2[None, :])
                 & (lane_nr[None, :] < pos2),
                 axis=1,
             )
+            lgood = lgood & ~lcoll
+        else:
+            lcoll = jnp.zeros(Rt, bool)
         lok = jnp.any(lgood)
         kstar = jnp.argmax(lgood)
         unresolved = unresolved | (ok & ~lok)
@@ -1306,6 +1340,25 @@ def _choose_firstn_one_fast(
         safe = jnp.clip(pos2, 0, NR - 1)
         out2 = out2.at[safe].set(jnp.where(place, leaf[rep][kstar], out2[safe]))
         pos2 = pos2 + jnp.where(place, 1, 0)
+        if with_diag:
+            # leaf recursion retries: same reconstruction as pass 1 (the
+            # host recursion attempts k = 0..ftotal sequentially)
+            lattempted = ~leaf_dead[rep] & jnp.where(
+                lok, jnp.arange(Rt) <= kstar, True)
+            lattempted = lattempted & ok  # no outer pick: no recursion
+            d_rej = d_rej + jnp.sum(
+                (lattempted & (lstat[rep] == _FOUND)
+                 & leaf_out[rep]).astype(jnp.int32))
+            d_coll = d_coll + jnp.sum(
+                (lattempted & leaf_sel[rep] & lcoll).astype(jnp.int32))
+            d_skip = d_skip + jnp.sum(
+                (lattempted & leaf_skip[rep]).astype(jnp.int32))
+            leaf_tries.append(
+                jnp.where(place, kstar, -1).astype(jnp.int32))
+    if with_diag:
+        dstep = {"tries": jnp.stack(tries_sel + leaf_tries),
+                 "coll": d_coll, "rej": d_rej, "skip": d_skip}
+        return out, out2, outpos, unresolved, dstep
     return out, out2, outpos, unresolved
 
 
@@ -1327,6 +1380,7 @@ def _choose_indep_one_fast(
     leaf_bound: int | None = None,
     levels: list | None = None,
     leaf_levels: list | None = None,
+    with_diag: bool = False,
 ):
     """crush_choose_indep with the per-round rep descents vectorized.
 
@@ -1447,11 +1501,25 @@ def _choose_indep_one_fast(
         ftotal, left, out, out2 = st
         return (left > 0) & (ftotal < tries)
 
-    _, _, out, out2 = lax.while_loop(
+    ftot, _, out, out2 = lax.while_loop(
         round_cond, round_body, (jnp.int32(0), jnp.int32(out_size), out, out2)
     )
     out = jnp.where(out == UNDEF, ITEM_NONE, out)
     out2 = jnp.where(out2 == UNDEF, ITEM_NONE, out2)
+    if with_diag:
+        # the host increments its histogram ONCE per indep call with the
+        # final round count (reference src/crush/mapper.c:843 header
+        # increment); the lane mirrors that.  Per-draw collision /
+        # rejection tallies would need state threaded through the round
+        # loop — deliberately left at 0 (diag_exact stays true for the
+        # tries lane, which is what the histogram unification consumes).
+        dstep = {
+            "tries": jnp.where(out_size > 0, ftot, -1)[None].astype(
+                jnp.int32),
+            "coll": jnp.int32(0), "rej": jnp.int32(0),
+            "skip": jnp.int32(0),
+        }
+        return out, out2, out_size, jnp.bool_(False), dstep
     return out, out2, out_size, jnp.bool_(False)
 
 
@@ -1460,7 +1528,7 @@ FAST_WINDOW_EXTRA = 8  # default r-window slack beyond numrep (see above)
 
 def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                  path: str = "auto", window_extra: int = FAST_WINDOW_EXTRA,
-                 with_flag: bool = False):
+                 with_flag: bool = False, with_diag: bool = False):
     """Build the single-x mapping function for one rule; vmap/jit-ready.
 
     Returns fn(x: u32 scalar, dev_weights: u32[max_devices]) -> i32[result_max]
@@ -1481,12 +1549,33 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
     Without with_flag there is no way to honor that contract, so
     path="auto" then resolves to the (always-exact) loop kernel;
     requesting the fast kernel flagless is an error.
+
+    with_diag: the fn additionally returns a diagnostics pytree — the
+    device-side flight recorder of every decision the C interpreter
+    makes invisible once fused: per-placement retry counts (`tries`,
+    -1 = unplaced; indexes the same histogram the host reference
+    mapper's collect_choose_tries fills), collision / out-of-weight-
+    rejection / skip_rep tallies (`coll`/`rej`/`skip`), the bad-mapping
+    flag (`bad`: result shorter than result_max), and the per-choose-
+    step work vectors (`steps` [n_choose_steps, RMAX]) the first-
+    divergence locator compares against the host oracle's step log.
+    Requires with_flag (diagnostics of an unresolved lane are garbage;
+    the flag tells the caller which lanes to mask / host-rescue).
+    Instrumentation is a STATIC plan fact folded into fn.cache_key —
+    the default variant's trace is untouched and keeps its own cache
+    entry.  `fn.diag_exact` says whether every choose step's tries
+    lanes reproduce the host histogram exactly (fast-path firstn and
+    non-leafy indep do; loop-path steps emit -1 lanes).
     """
     if path == "auto" and not with_flag:
         path = "loop"
     assert not (path == "fast" and not with_flag), (
         "fast kernel's bounded window is inexact without the unresolved "
         "flag + caller rescue; pass with_flag=True (or use compile_batched)"
+    )
+    assert not (with_diag and not with_flag), (
+        "with_diag needs the unresolved flag: flagged lanes carry "
+        "garbage diagnostics and the caller must mask or host-rescue them"
     )
     t = A.tunables
     assert t.choose_local_tries == 0 and t.choose_local_fallback_tries == 0, (
@@ -1541,7 +1630,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
     host_tables = host_base_tables(A)
     rowlvl: dict[str, dict] = {}
     key_parts: list = [
-        "crush_rule", RMAX, path, window_extra, with_flag,
+        "crush_rule", RMAX, path, window_extra, with_flag, with_diag,
         A.n_buckets, A.max_size, A.max_nodes, A.positions,
         A.max_devices, A.max_depth,
         (t.choose_local_tries, t.choose_local_fallback_tries,
@@ -1553,6 +1642,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
     plan: list[dict] = []
     wbound = 0  # static upper bound on wsize
     src_slots: list[int] = []  # statically-known source bucket slots
+    diag_exact = True  # every choose step's tries lanes host-exact?
     for si, (op, arg1, arg2, s_tries, s_leaf_tries, s_vary_r,
              s_stable) in enumerate(steps):
         if op == RuleOp.TAKE:
@@ -1620,6 +1710,16 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
             for lv in (levels or []) + (leaf_levels or []):
                 if lv.row_ok:
                     rowlvl[lv.key] = lv.host_tab()
+            # diagnostics lanes this step contributes per source bucket:
+            # firstn books one placement per rep (two for chooseleaf —
+            # outer + leaf recursion, mirroring the host histogram's
+            # double increment); indep books one call-level lane.  Loop-
+            # path steps and leafy indep cannot reproduce the host
+            # increments and mark the whole plan inexact.
+            diag_lanes = (NR * (2 if leafy else 1)) if firstn else 1
+            diag_exact = diag_exact and use_fast and (
+                firstn or not leafy
+            )
             plan.append({
                 "kind": "choose", "numrep": numrep, "NR": NR,
                 "firstn": firstn, "leafy": leafy, "arg2": arg2,
@@ -1628,6 +1728,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                 "use_fast": use_fast, "bound": bound,
                 "leaf_bound": leaf_bound, "levels": levels,
                 "leaf_levels": leaf_levels, "wbound": min(wbound, RMAX),
+                "diag_lanes": diag_lanes,
             })
             key_parts.append((
                 "choose", int(op), numrep, arg2, s_tries, recurse_tries,
@@ -1659,6 +1760,9 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
         result = jnp.full(RMAX, ITEM_NONE, jnp.int32)
         rlen = jnp.int32(0)
         unresolved = jnp.bool_(False)
+        tries_parts: list = []  # with_diag: per-placement retry lanes
+        step_items: list = []   # with_diag: post-step work vectors
+        d_coll = d_rej = d_skip = jnp.int32(0)
 
         for st in plan:
             if st["kind"] == "take":
@@ -1679,7 +1783,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                             src_ok, RMAX - osize, 0
                         )
                         if st["use_fast"]:
-                            vals, leafs, n, flg = _choose_firstn_one_fast(
+                            got = _choose_firstn_one_fast(
                                 d, x, src, count, dev_weights,
                                 numrep=numrep, target_type=arg2,
                                 recurse_to_leaf=leafy, tries=st["tries"],
@@ -1691,7 +1795,16 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                                 leaf_bound=st["leaf_bound"],
                                 levels=st["levels"],
                                 leaf_levels=st["leaf_levels"],
+                                with_diag=with_diag,
                             )
+                            if with_diag:
+                                vals, leafs, n, flg, dstep = got
+                                tries_parts.append(dstep["tries"])
+                                d_coll = d_coll + dstep["coll"]
+                                d_rej = d_rej + dstep["rej"]
+                                d_skip = d_skip + dstep["skip"]
+                            else:
+                                vals, leafs, n, flg = got
                             unresolved = unresolved | flg
                         else:
                             vals, leafs, n = _choose_firstn_one(
@@ -1702,6 +1815,11 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                                 vary_r=st["vary_r"], stable=st["stable"],
                                 weight_max=weight_max, out_bound=NR,
                             )
+                            if with_diag:
+                                # loop path: no per-draw visibility
+                                # (diag_exact is False for this plan)
+                                tries_parts.append(jnp.full(
+                                    st["diag_lanes"], -1, jnp.int32))
                     else:
                         out_size = jnp.where(
                             src_ok,
@@ -1709,7 +1827,7 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                             0,
                         )
                         if st["use_fast"]:
-                            vals, leafs, n, _ = _choose_indep_one_fast(
+                            got = _choose_indep_one_fast(
                                 d, x, src, out_size, dev_weights,
                                 numrep=numrep, target_type=arg2,
                                 recurse_to_leaf=leafy, tries=st["tries"],
@@ -1719,7 +1837,13 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                                 leaf_bound=st["leaf_bound"],
                                 levels=st["levels"],
                                 leaf_levels=st["leaf_levels"],
+                                with_diag=with_diag,
                             )
+                            if with_diag:
+                                vals, leafs, n, _, dstep = got
+                                tries_parts.append(dstep["tries"])
+                            else:
+                                vals, leafs, n, _ = got
                         else:
                             vals, leafs, n = _choose_indep_one(
                                 d, x, src, out_size, dev_weights,
@@ -1728,6 +1852,9 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                                 recurse_tries=st["recurse_tries"],
                                 weight_max=weight_max, out_bound=NR,
                             )
+                            if with_diag:
+                                tries_parts.append(jnp.full(
+                                    st["diag_lanes"], -1, jnp.int32))
                     emit_vals = leafs if leafy else vals
                     # scatter emit_vals[:n] into o at osize
                     idx = osize + jnp.arange(NR)
@@ -1739,6 +1866,8 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                     osize = osize + n
                 w_items = o
                 wsize = jnp.minimum(osize, RMAX)
+                if with_diag:
+                    step_items.append(w_items)
             elif st["kind"] == "emit":
                 idx = rlen + jnp.arange(RMAX)
                 keep = (jnp.arange(RMAX) < wsize) & (idx < RMAX)
@@ -1748,12 +1877,30 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
                 rlen = jnp.minimum(rlen + wsize, RMAX)
                 w_items = jnp.full(RMAX, ITEM_NONE, jnp.int32)
                 wsize = jnp.int32(0)
+        if with_diag:
+            valid_n = jnp.sum((result != ITEM_NONE).astype(jnp.int32))
+            diag = {
+                "tries": (jnp.concatenate(tries_parts) if tries_parts
+                          else jnp.zeros(0, jnp.int32)),
+                "coll": d_coll, "rej": d_rej, "skip": d_skip,
+                "bad": (valid_n < RMAX).astype(jnp.int32),
+                "steps": (jnp.stack(step_items) if step_items
+                          else jnp.zeros((0, RMAX), jnp.int32)),
+            }
+            return result, unresolved, diag
         if with_flag:
             return result, unresolved
         return result
 
     fn.cache_key = cache_key
     fn.host_tables = host_tables
+    fn.diag_exact = diag_exact
+    fn.diag_tries_bound = t.choose_total_tries
+    fn.diag_lanes = sum(
+        st["wbound"] * st["diag_lanes"]
+        for st in plan if st["kind"] == "choose"
+    )
+    fn.diag_steps = sum(1 for st in plan if st["kind"] == "choose")
     return fn
 
 

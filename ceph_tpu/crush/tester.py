@@ -51,8 +51,12 @@ class TesterConfig:
     show_statistics: bool = False
     # per-placement retry histogram (reference src/crush/mapper.c:640-643
     # choose_tries bookkeeping + CrushTester's --show-choose-tries dump).
-    # Collected by the host reference mapper: the tester transparently
-    # routes the mapping loop through backend "ref" when this is set.
+    # Single source of truth: the device diagnostics planes
+    # (mapper_jax with_diag -> crush.explain.device_choose_tries) when
+    # the jax backend's compiled plan is diag-exact — bit-identical to
+    # the host collection; other backends, inexact plans, and
+    # fast-window-flagged lanes route through the instrumented host
+    # reference mapper.
     show_choose_tries: bool = False
 
 
@@ -142,6 +146,36 @@ class CrushTester:
             collect_choose_tries=self.cfg.show_choose_tries,
         )
 
+    def _collect_tries_jax(self, ruleno: int, real_xs: np.ndarray,
+                           nr: int) -> bool:
+        """Fold this (rule, numrep) pass's per-placement retry counts
+        into the histogram FROM THE DEVICE diagnostics planes.  Returns
+        False when the compiled plan cannot reproduce the host
+        increments exactly (loop-path steps, leafy indep) — the caller
+        then routes the pass through the host mapper instead.  Lanes the
+        fast window flagged are re-collected host-side (the same rescue
+        contract the mapping path uses), so the histogram is
+        bit-identical to a pure host collection either way."""
+        from ceph_tpu.utils import ensure_jax_backend
+
+        ensure_jax_backend()
+        from ceph_tpu.crush import explain
+
+        hist = self.m.choose_tries_histogram
+        try:
+            dev_hist, unresolved = explain.device_choose_tries(
+                self.m_arrays(), ruleno, nr, real_xs,
+                np.asarray(self.weight, np.uint32), len(hist),
+            )
+        except ValueError:  # not diag-exact
+            return False
+        for i, v in enumerate(dev_hist):
+            hist[i] += int(v)
+        for x in real_xs[unresolved]:
+            mapper_ref.do_rule(self.m, ruleno, int(x), nr, self.weight,
+                               collect_choose_tries=True)
+        return True
+
     def _random_placement(
         self, rng: np.random.Generator, nr: int
     ) -> list[int]:
@@ -183,12 +217,14 @@ class CrushTester:
         cfg, m = self.cfg, self.m
         backend = cfg.backend
         if cfg.show_choose_tries:
-            # only the host reference mapper instruments its retry loops
-            # (local override: the caller's config is not mutated)
-            backend = "ref"
             m.choose_tries_histogram = [0] * (
                 m.tunables.choose_total_tries + 1
             )
+            if backend != "jax":
+                # only jax (diagnostics planes) and ref (instrumented
+                # host walk) can collect; native routes through ref
+                # (local override: the caller's config is not mutated)
+                backend = "ref"
         rules = (
             [cfg.rule]
             if cfg.rule >= 0
@@ -219,12 +255,22 @@ class CrushTester:
                 per = np.zeros(m.max_devices, np.int64)
                 sizes: dict[int, int] = {}
                 xs = np.arange(cfg.min_x, cfg.max_x + 1, dtype=np.int64)
+                pass_backend = backend
+                if (cfg.show_choose_tries and pass_backend == "jax"
+                        and not cfg.simulate):
+                    # histogram from the device diagnostics planes;
+                    # plans that cannot reproduce the host increments
+                    # route the whole pass through the host mapper
+                    if not self._collect_tries_jax(
+                        r, self._real_xs(xs), nr
+                    ):
+                        pass_backend = "ref"
                 if cfg.simulate:
                     rows = [
                         self._random_placement(rng, nr) for _ in range(n_x)
                     ]
                     prefix = "RNG"
-                elif backend == "native":
+                elif pass_backend == "native":
                     from ceph_tpu.native.mapper import NativeMapper
 
                     if getattr(self, "_nm", None) is None:
@@ -234,7 +280,7 @@ class CrushTester:
                     )
                     rows = self._rows_from_padded(padded, rule)
                     prefix = "CRUSH"
-                elif backend == "ref":
+                elif pass_backend == "ref":
                     rows = [
                         self._map_one_ref(r, int(rx), nr)
                         for rx in self._real_xs(xs)

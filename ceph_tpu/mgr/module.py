@@ -508,4 +508,37 @@ class Balancer:
             )
         with obs.span("mgr.execute", plan=plan.name, mode=plan.mode):
             apply_incremental(m, inc)
+        self._diagnose_executed(plan, m)
         return 0, ""
+
+    def _diagnose_executed(self, plan: Plan, m: OSDMap) -> None:
+        """Post-execute decision accounting (CEPH_TPU_PLACEMENT_DIAG):
+        run the instrumented pipeline over the plan's pools on the map
+        the plan just produced, booking per-epoch bad-mapping /
+        retry-exhaustion counts under source "mgr.<plan>" — the
+        balancer-loop half of the placement flight recorder."""
+        from ceph_tpu.utils import knobs
+
+        if knobs.get("CEPH_TPU_PLACEMENT_DIAG", "0") != "1":
+            return
+        from ceph_tpu.obs import placement
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+        from ceph_tpu.runtime import DeviceLostError
+
+        by_name = {v: k for k, v in m.pool_name.items()}
+        pids = sorted(
+            by_name[p] for p in (plan.pools or m.pool_name.values())
+            if p in by_name
+        )
+        agg: dict = {"epoch": int(m.epoch), "mode": plan.mode}
+        for pid in pids:
+            # Diagnostics must never fail an execute whose incremental
+            # already landed (same contract as ClusterSim._diagnose_epoch).
+            try:
+                placement.fold_summary(
+                    agg, PoolMapper(m, pid).diagnose(record=False))
+            except DeviceLostError as e:
+                _log(1, f"device lost diagnosing pool {pid} ({e}); "
+                        "skipping placement accounting")
+                return
+        placement.record(f"mgr.{plan.name}", agg)

@@ -28,7 +28,7 @@ Importing this package is cheap (no jax import) and, when
 
 from __future__ import annotations
 
-from ceph_tpu.obs import executables, quantiles, spans, trace
+from ceph_tpu.obs import executables, placement, quantiles, spans, trace
 from ceph_tpu.obs.admin_socket import maybe_start_from_env
 from ceph_tpu.obs.jax_accounting import JitAccount, timed_fetch
 from ceph_tpu.obs.trace import (
@@ -51,10 +51,11 @@ from ceph_tpu.utils.perf_counters import (
 def prometheus_text() -> str:
     """Prometheus text exposition of the whole perf registry, plus the
     executable-registry gauges (per-cache entry counts, compile seconds,
-    dispatch totals)."""
+    dispatch totals) and the placement-diagnostics per-source gauges."""
     from ceph_tpu.obs.prometheus import prometheus_text as _render
 
-    return _render(perf_dump()) + executables.prometheus_gauges()
+    return (_render(perf_dump()) + executables.prometheus_gauges()
+            + placement.prometheus_gauges())
 
 
 def jit_counters() -> dict:
@@ -100,6 +101,7 @@ __all__ = [
     "logger_for",
     "perf_dump",
     "perf_schema",
+    "placement",
     "prometheus_text",
     "quantiles",
     "reset_values",

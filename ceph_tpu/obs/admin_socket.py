@@ -16,6 +16,13 @@ protocol here:
                                 point it at a wedged device — `perf dump`
                                 is the always-answers path)
     client: "trace flush\\n"    server: {"path": <trace file or null>}
+    client: "bad dump\\n"       server: placement-diagnostics snapshots
+                                (per-source bad-mapping / retry planes
+                                booked by PoolMapper.diagnose)
+    client: "explain 1.42\\n"   server: host-oracle decision log for PG
+                                42 of pool 1 (an explainer must have
+                                been registered by a PoolMapper of that
+                                pool in THIS process)
     client: "runtime\\n"        server: backend-acquisition provenance
                                 + armed fault points
     client: "help\\n"           server: command list JSON
@@ -42,7 +49,7 @@ _server: "AdminSocket | None" = None
 
 COMMANDS = (
     "perf dump", "perf schema", "perf reset", "metrics", "cache dump",
-    "trace flush", "runtime", "help",
+    "bad dump", "explain <pool>.<seed>", "trace flush", "runtime", "help",
 )
 
 
@@ -75,6 +82,20 @@ def handle_command(cmd: str) -> str:
         # cache per record)
         return json.dumps(executables.dump(analyze=True, budget_s=5.0),
                           indent=1, sort_keys=True)
+    if cmd == "bad dump":
+        # the placement flight-recorder surface: latest diagnostics
+        # snapshot per source + the aggregate placement counters
+        from ceph_tpu.obs import placement
+
+        return json.dumps(placement.dump(), indent=1, sort_keys=True)
+    if cmd.startswith("explain"):
+        from ceph_tpu.obs import placement
+
+        arg = cmd[len("explain"):].strip()
+        if not arg:
+            return json.dumps(
+                {"error": "usage: explain <pool>.<seed>"})
+        return json.dumps(placement.explain(arg), indent=1)
     if cmd == "trace flush":
         return json.dumps({"path": trace.flush()})
     if cmd == "runtime":

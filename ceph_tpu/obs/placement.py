@@ -1,0 +1,201 @@
+"""Placement-decision observability — the surface over the CRUSH
+flight recorder.
+
+The batched pipeline fuses millions of `crush_do_rule` calls into one
+XLA executable, and every decision inside it — retries, collisions,
+out-of-weight rejections, rescue-lane activations, bad mappings — is
+invisible from the outside.  The instrumented kernel variant
+(`mapper_jax.compile_rule(with_diag=True)`) re-exposes them as device
+arrays; THIS module is where those arrays become operator-visible
+state:
+
+- a `placement` perf-counter group (u64 decision tallies, a
+  `choose_tries` histogram counter fed by `merge_histogram` from the
+  device-reduced retry histogram, and a `diagnose_seconds` quantile for
+  the instrumented dispatch itself);
+- a per-source snapshot store (`record()` / `dump()`): the latest
+  diagnostics summary per producer ("pool0", "sim.epoch12",
+  "mgr.optimize", bench), served by the daemon `bad dump` admin command;
+- Prometheus gauges for the snapshot-only numbers (the perf-group
+  counters render through the registry exposition already);
+- an explainer registry (`register_explainer()` / `explain()`): a live
+  process's PoolMapper publishes a host-oracle replay closure so the
+  daemon `explain <pgid>` command can answer for the maps it actually
+  serves.
+
+Import-light: no jax at module load (the snapshot payloads are plain
+python by the time they arrive here).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ceph_tpu.utils.perf_counters import logger_for
+
+# retry counts are small non-negative ints; integer bounds make the
+# histogram exact (value == bound), and 0..63 covers every tunable
+# default (choose_total_tries=50) with headroom for SET_CHOOSE_TRIES
+TRIES_BOUNDS = list(range(64))
+
+_L = logger_for("placement")
+_L.add_u64("pgs_diagnosed",
+           "PGs run through the instrumented (with_diag) pipeline")
+_L.add_u64("bad_mappings",
+           "diagnosed PGs whose CRUSH result was shorter than numrep "
+           "(the tester's bad-mapping test, on device)")
+_L.add_u64("retry_exhausted",
+           "diagnostics lanes left unplaced (-1 retry marker): the "
+           "choose walk ran out of tries or candidates")
+_L.add_u64("collisions",
+           "duplicate-item rejections across diagnosed choose draws")
+_L.add_u64("rejections_out",
+           "out-of-weight (is_out) rejections across diagnosed draws")
+_L.add_u64("skips",
+           "skip_rep draws (dead source bucket / wrong item type / "
+           "exhausted count) across diagnosed choose walks")
+_L.add_u64("unresolved_masked",
+           "diagnosed lanes excluded from the planes because the fast "
+           "window flagged them (rescued exactly elsewhere)")
+_L.add_histogram(
+    "choose_tries", TRIES_BOUNDS,
+    "per-placement retry histogram folded from the device diagnostics "
+    "planes (the reference collect_choose_tries shape; bucket value == "
+    "retry count)")
+_L.add_quantile(
+    "diagnose_seconds",
+    "instrumented-pipeline dispatch wall time per diagnose() block")
+
+_lock = threading.Lock()
+_snapshots: dict[str, dict] = {}
+_explainers: dict[str, object] = {}
+
+
+def fold_summary(agg: dict, s: dict) -> dict:
+    """Elementwise-fold one diagnostics summary into an aggregate (the
+    per-epoch shape sim/ and the balancer loop book): scalar tallies
+    sum, retry histograms sum index-wise, diag_exact ANDs.  Returns
+    `agg` (for chaining)."""
+    for k in ("pgs", "bad_mappings", "retry_exhausted", "collisions",
+              "rejections", "skips", "unresolved"):
+        agg[k] = agg.get(k, 0) + int(s.get(k, 0))
+    hist = s.get("tries_histogram") or []
+    ah = agg.setdefault("tries_histogram", [])
+    if len(ah) < len(hist):
+        ah.extend([0] * (len(hist) - len(ah)))
+    for i, v in enumerate(hist):
+        ah[i] += int(v)
+    agg["diag_exact"] = bool(agg.get("diag_exact", True)
+                             and s.get("diag_exact", False))
+    return agg
+
+
+def record(source: str, summary: dict) -> dict:
+    """Book one diagnostics summary into the perf group and the
+    snapshot store.  `summary` is the plain-python dict produced by
+    PoolMapper.diagnose / explain.diag_summary: pgs, bad_mappings,
+    retry_exhausted, collisions, rejections, skips, unresolved,
+    tries_histogram (list[int], index == retry count), diag_exact.
+    Returns the summary (for chaining)."""
+    _L.inc("pgs_diagnosed", int(summary.get("pgs", 0)))
+    _L.inc("bad_mappings", int(summary.get("bad_mappings", 0)))
+    _L.inc("retry_exhausted", int(summary.get("retry_exhausted", 0)))
+    _L.inc("collisions", int(summary.get("collisions", 0)))
+    _L.inc("rejections_out", int(summary.get("rejections", 0)))
+    _L.inc("skips", int(summary.get("skips", 0)))
+    _L.inc("unresolved_masked", int(summary.get("unresolved", 0)))
+    hist = summary.get("tries_histogram")
+    if hist:
+        _L.merge_histogram("choose_tries", list(hist))
+    with _lock:
+        _snapshots[source] = dict(summary)
+    return summary
+
+
+def dump() -> dict:
+    """The daemon `bad dump` payload: latest snapshot per source plus
+    the aggregate perf-group values."""
+    with _lock:
+        sources = {k: dict(v) for k, v in _snapshots.items()}
+    return {
+        "sources": sources,
+        "counters": _L.dump(),
+        "explainers": sorted(_explainers),
+    }
+
+
+def reset() -> None:
+    """Test isolation: drop snapshots and explainers (perf counters are
+    zeroed by the registry-wide reset, not here)."""
+    with _lock:
+        _snapshots.clear()
+        _explainers.clear()
+
+
+def register_explainer(key: str, fn) -> None:
+    """Publish a replay closure `fn(x: int) -> dict` (the host-oracle
+    decision log for one placement seed) under `key` — PoolMapper
+    registers "pool<id>" so a live daemon can answer `explain`."""
+    with _lock:
+        _explainers[key] = fn
+
+
+def explain(pgid: str) -> dict:
+    """Admin-command entry: `pgid` is "<pool>.<seed>" (the reference
+    pgid spelling) or "<pool> <seed>".  Replays through the explainer
+    registered under "pool<pool>"."""
+    parts = pgid.replace(".", " ").split()
+    if len(parts) != 2:
+        return {"error": f"pgid {pgid!r} not of the form <pool>.<seed>"}
+    key, x = f"pool{parts[0]}", parts[1]
+    with _lock:
+        fn = _explainers.get(key)
+    if fn is None:
+        with _lock:
+            known = sorted(_explainers)
+        return {"error": f"no explainer registered for {key!r}",
+                "registered": known}
+    try:
+        return fn(int(x))
+    except Exception as e:  # the admin surface reports, never raises
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _esc(label: str) -> str:
+    """Prometheus label-value escaping (`\\`, `"`, newline) — sources
+    embed user-chosen plan names, unlike the internal-constant labels
+    elsewhere in obs."""
+    return (label.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
+def prometheus_gauges() -> str:
+    """Gauges for the snapshot-only numbers (per-source bad mappings /
+    retry exhaustion); the placement perf-group counters render through
+    the registry exposition."""
+    with _lock:
+        items = sorted(_snapshots.items())
+    if not items:
+        return ""
+    lines = [
+        "# HELP ceph_tpu_placement_source_bad_mappings latest diagnosed "
+        "bad-mapping count per source",
+        "# TYPE ceph_tpu_placement_source_bad_mappings gauge",
+    ]
+    for src, s in items:
+        lines.append(
+            f'ceph_tpu_placement_source_bad_mappings{{source="{_esc(src)}"}} '
+            f'{int(s.get("bad_mappings", 0))}'
+        )
+    lines += [
+        "# HELP ceph_tpu_placement_source_retry_exhausted latest "
+        "unplaced-lane count per source",
+        "# TYPE ceph_tpu_placement_source_retry_exhausted gauge",
+    ]
+    for src, s in items:
+        lines.append(
+            f'ceph_tpu_placement_source_retry_exhausted{{source="{_esc(src)}"}} '
+            f'{int(s.get("retry_exhausted", 0))}'
+        )
+    return "\n".join(lines) + "\n"

@@ -32,6 +32,9 @@ SPANS: dict[str, str] = {
     "pipeline.map_block": "dispatch of one jitted fast-path block",
     "pipeline.rescue": "dispatch of exact-loop recompute of flagged lanes",
     "pipeline.fetch": "d2h fetch of finished mapping results",
+    "pipeline.diagnose": "dispatch of one instrumented (with_diag) block",
+    # crush/explain.py — placement-decision triage
+    "crush.diag_batch": "instrumented rule-kernel batch (tries planes)",
     # bench.py drivers
     "bench.cold_pass": "first full mapping pass (includes compiles)",
     "bench.warm_pass": "steady-state full mapping pass",
@@ -97,6 +100,7 @@ PREFIXES: tuple[str, ...] = (
 DISPATCH_SPANS: tuple[str, ...] = (
     "pipeline.map_block",
     "pipeline.rescue",
+    "pipeline.diagnose",
     "ec.gf_dispatch",
 )
 

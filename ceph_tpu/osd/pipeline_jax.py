@@ -229,6 +229,7 @@ def compile_pipeline(
     with_primary_affinity: bool = True,
     path: str = "auto",
     with_flag: bool = False,
+    with_diag: bool = False,
     window_extra: int = FAST_WINDOW_EXTRA,
     pool_operands: bool = False,
 ):
@@ -252,12 +253,22 @@ def compile_pipeline(
     identical for every value) instead of baking them — pools that share
     structure (rule, size, osd bound, overlay gates) then share one
     executable regardless of pool id or pg count (cache_key drops them).
+
+    with_diag: the tuple grows a trailing diagnostics pytree from the
+    instrumented CRUSH kernel (see mapper_jax.compile_rule with_diag) —
+    the device-side flight recorder behind PoolMapper.diagnose.
+    Requires with_flag; a static plan fact folded into cache_key, so the
+    default pipeline's trace and cache entry are untouched.
     """
+    assert not (with_diag and not with_flag), (
+        "with_diag needs with_flag: flagged lanes carry garbage "
+        "diagnostics and the caller must mask or host-rescue them"
+    )
     W = spec.out_width
     R = spec.size
     rule_fn = (
         compile_rule(A, spec.ruleno, R, path=path, with_flag=with_flag,
-                     window_extra=window_extra)
+                     with_diag=with_diag, window_extra=window_extra)
         if spec.ruleno >= 0 else None
     )
     D = A.max_devices  # crush device-id bound (weight vec for the kernel)
@@ -296,8 +307,17 @@ def compile_pipeline(
 
         # -- stage 2: CRUSH (reference src/osd/OSDMap.cc:2444-2447) --------
         unresolved = jnp.bool_(False)
+        dg = None
         if rule_fn is None:
             raw = jnp.full(W, ITEM_NONE, jnp.int32)
+            if with_diag:  # no rule: trivially bad, nothing decided
+                dg = {"tries": jnp.zeros(0, jnp.int32),
+                      "coll": jnp.int32(0), "rej": jnp.int32(0),
+                      "skip": jnp.int32(0), "bad": jnp.int32(1),
+                      "steps": jnp.zeros((0, R), jnp.int32)}
+        elif with_diag:
+            raw, unresolved, dg = rule_fn(pps, weight[:D], tabs)
+            raw = _pad_lanes(raw, W)
         elif with_flag:
             raw, unresolved = rule_fn(pps, weight[:D], tabs)
             raw = _pad_lanes(raw, W)
@@ -406,6 +426,8 @@ def compile_pipeline(
                 )
             else:
                 acting_primary = jnp.where(pt >= 0, pt, up_primary)
+        if with_diag:
+            return up, up_primary, acting, acting_primary, unresolved, dg
         if with_flag:
             return up, up_primary, acting, acting_primary, unresolved
         return up, up_primary, acting, acting_primary
@@ -424,11 +446,14 @@ def compile_pipeline(
          spec.size, spec.can_shift, spec.hashpspool, spec.ruleno,
          spec.max_osd, spec.out_width),
         with_upmap_full, n_upmap_pairs, with_temp, with_primary_temp,
-        with_primary_affinity, path, with_flag, window_extra,
+        with_primary_affinity, path, with_flag, with_diag, window_extra,
         pool_operands,
         getattr(rule_fn, "cache_key", ("norule", spec.ruleno)),
     )
     fn.host_tables = getattr(rule_fn, "host_tables", {})
+    fn.diag_exact = getattr(rule_fn, "diag_exact", False)
+    fn.diag_tries_bound = getattr(rule_fn, "diag_tries_bound", 0)
+    fn.diag_lanes = getattr(rule_fn, "diag_lanes", 0)
     return fn
 
 
@@ -501,6 +526,8 @@ class PoolMapper:
         self.refresh_dev()
         self._jitted = None
         self._jloop = None
+        self._diag_fn = None
+        self._jdiag = None
         self.chunk = chunk
 
     def refresh_dev(self) -> None:
@@ -570,6 +597,113 @@ class PoolMapper:
         if self._jloop is None:
             self._jloop = self._cached_jit("loop", self.fn)
         return self._jloop
+
+    def jitted_diag(self):
+        """The jitted vmapped INSTRUMENTED pipeline (with_diag): the
+        device-side flight recorder.  A separate _PIPE_CACHE entry —
+        instrumentation is a static plan fact in cache_key, so building
+        it never touches the default kernels' executables."""
+        if self._jdiag is None:
+            if self._diag_fn is None:
+                self._diag_fn = compile_pipeline(
+                    self.arrays, self.spec, path="auto", with_flag=True,
+                    with_diag=True, window_extra=self.window_extra,
+                    pool_operands=True, **self._pipe_kw,
+                )
+            self._jdiag = self._cached_jit("diag", self._diag_fn)
+        return self._jdiag
+
+    def diagnose(self, ps: np.ndarray | None = None,
+                 source: str | None = None, record: bool = True) -> dict:
+        """Run the instrumented pipeline over `ps` (default: every PG)
+        and reduce the per-PG decision planes ON DEVICE into a
+        placement-diagnostics summary: the per-placement retry histogram
+        (the reference collect_choose_tries shape), collision /
+        out-of-weight-rejection / skip tallies, bad-mapping and
+        retry-exhaustion counts.  Only the O(tries-bound) histogram and
+        a few scalars are fetched — never the per-PG planes.
+
+        Fast-window-flagged lanes are EXCLUDED from every plane (their
+        diagnostics are garbage by the with_diag contract; production
+        mapping rescues them through the exact loop kernel) and reported
+        as `unresolved`.  `diag_exact` says whether the retry lanes
+        reproduce the host histogram bit-for-bit (fast-path firstn and
+        non-leafy indep plans do).
+
+        The summary lands in the `placement` perf group and snapshot
+        store (`obs.placement`) unless record=False."""
+        from ceph_tpu.core import reduce
+        from ceph_tpu.obs import placement
+
+        if ps is None:
+            ps = np.arange(self.spec.pg_num, dtype=np.uint32)
+        ps = np.asarray(ps)
+        n = len(ps)
+        jdiag = self.jitted_diag()
+        dfn = self._diag_fn
+        bound = min(int(dfn.diag_tries_bound),
+                    len(placement.TRIES_BOUNDS) - 1)
+        B = min(self.chunk or DEFAULT_CHUNK, n)
+        _PL = obs.logger_for("placement")
+        hist = jnp.zeros(bound + 1, jnp.int64)
+        coll = rej = skip = bad = exhausted = jnp.int64(0)
+        n_unres = jnp.int64(0)
+        for i in range(0, n, B):
+            blk = np.resize(ps[i:i + B], B)  # cycle-pad: one shape
+            real = np.arange(B) < (n - i)
+            nreal = int(real.sum())
+            with obs.span("pipeline.diagnose", pgs=nreal), \
+                    _PL.time("diagnose_seconds"):
+                _, _, _, _, flg, dg = jdiag(
+                    jnp.asarray(blk, np.uint32), self.dev,
+                    self._ov_rows(blk),
+                )
+            ok = jnp.asarray(real) & ~flg  # [B] lanes the planes cover
+            hist = hist + reduce.value_histogram(
+                dg["tries"], bound, extra_mask=ok[:, None])
+            okw = ok.astype(jnp.int64)
+            coll = coll + jnp.sum(dg["coll"].astype(jnp.int64) * okw)
+            rej = rej + jnp.sum(dg["rej"].astype(jnp.int64) * okw)
+            skip = skip + jnp.sum(dg["skip"].astype(jnp.int64) * okw)
+            bad = bad + jnp.sum(dg["bad"].astype(jnp.int64) * okw)
+            if dfn.diag_exact:
+                # -1 tries = unfilled lane = exhaustion ONLY on exact
+                # plans; loop-path/leafy-indep plans fill whole planes
+                # with -1 (uninstrumented), which is not exhaustion
+                exhausted = exhausted + jnp.sum(
+                    ((dg["tries"] < 0) & ok[:, None]).astype(jnp.int64))
+            n_unres = n_unres + jnp.sum(
+                (flg & jnp.asarray(real)).astype(jnp.int64))
+        with obs.span("pipeline.fetch"):
+            hist_v = np.asarray(hist)
+            scalars = np.asarray(jnp.stack(
+                [coll, rej, skip, bad, exhausted, n_unres]))
+        summary = {
+            "pgs": n,
+            "pool_id": self.pool_id,
+            "tries_histogram": [int(v) for v in hist_v],
+            "tries_bound": bound,
+            "diag_exact": bool(dfn.diag_exact),
+            "diag_lanes": int(dfn.diag_lanes),
+            "collisions": int(scalars[0]),
+            "rejections": int(scalars[1]),
+            "skips": int(scalars[2]),
+            "bad_mappings": int(scalars[3]),
+            "retry_exhausted": int(scalars[4]),
+            "unresolved": int(scalars[5]),
+        }
+        if record:
+            placement.record(source or f"pool{self.pool_id}", summary)
+            placement.register_explainer(
+                f"pool{self.pool_id}", self._explain_seed)
+        return summary
+
+    def _explain_seed(self, seed: int) -> dict:
+        """Host-oracle replay of one placement seed of this pool — the
+        daemon `explain <pool>.<seed>` payload."""
+        from ceph_tpu.crush.explain import explain_pool_pg
+
+        return explain_pool_pg(self.m, self.pool_id, seed)
 
     def _ov_rows(self, ps: np.ndarray) -> dict:
         ov, rows = self.ov, {}

@@ -135,16 +135,61 @@ def diff_mappings(
 
 
 class ClusterSim:
-    """Stateful failure simulator: apply events, measure movement."""
+    """Stateful failure simulator: apply events, measure movement.
 
-    def __init__(self, m: OSDMap, backend: str = "jax"):
+    diagnostics: run the instrumented placement-diagnostics pass after
+    every epoch — per-epoch bad-mapping / retry-exhaustion accounting
+    (`diag_history`, latest snapshot under source "sim" in
+    `obs.placement`).  Defaults to the CEPH_TPU_PLACEMENT_DIAG knob:
+    the pass costs one extra mapping dispatch per epoch."""
+
+    def __init__(self, m: OSDMap, backend: str = "jax",
+                 diagnostics: bool | None = None):
+        from ceph_tpu.utils import knobs
+
         self.m = m
         self.backend = backend
         self.epoch = m.epoch
+        if diagnostics is None:
+            diagnostics = knobs.get("CEPH_TPU_PLACEMENT_DIAG", "0") == "1"
+        self.diagnostics = diagnostics
+        self.diag_history: list[tuple[str, dict]] = []
         # provenance of degraded mapping passes (device loss -> ref)
         self.fallback_events: list[str] = []
         self.current = _map_all(m, backend, self.fallback_events)
         self.history: list[tuple[str, MovementReport]] = []
+        if self.diagnostics:
+            self._diagnose_epoch("init")
+
+    def _diagnose_epoch(self, label: str) -> dict:
+        """Per-epoch decision accounting over every pool.  jax pools run
+        the instrumented device pipeline (full retry/collision planes);
+        a ref/degraded pass falls back to host-side bad-mapping counts
+        from the rows already mapped (no retry visibility)."""
+        from ceph_tpu.obs import placement
+
+        agg: dict = {"epoch": int(self.epoch), "label": label}
+        for pid in sorted(self.m.pools):
+            s = None
+            if self.backend == "jax":
+                from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+                try:
+                    s = PoolMapper(self.m, pid).diagnose(record=False)
+                except DeviceLostError as e:
+                    _log(1, f"device lost diagnosing pool {pid} ({e}); "
+                            "host bad-mapping counts only")
+            if s is None:
+                up = self.current[pid][0]
+                occupied = (np.asarray(up) != ITEM_NONE).sum(axis=1)
+                s = {"pgs": int(up.shape[0]),
+                     "bad_mappings": int(
+                         (occupied < self.m.pools[pid].size).sum()),
+                     "diag_exact": False}
+            placement.fold_summary(agg, s)
+        placement.record("sim", agg)
+        self.diag_history.append((label, agg))
+        return agg
 
     def provenance(self) -> dict:
         """Which backend produced the placements, and every degradation
@@ -162,6 +207,8 @@ class ClusterSim:
         rep = diff_mappings(self.current, new, self.m.pools)
         self.current = new
         self.history.append((label, rep))
+        if self.diagnostics:
+            self._diagnose_epoch(label)
         return rep
 
     # -- events ------------------------------------------------------------

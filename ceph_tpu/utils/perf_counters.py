@@ -193,6 +193,32 @@ class PerfCounters:
             c.sum += v
             c.count += 1
 
+    def merge_histogram(self, key: str, counts: list[int],
+                        values: list[float] | None = None) -> None:
+        """Fold a precomputed histogram into a histogram counter:
+        `counts[i]` observations of `values[i]` (default: value == i —
+        the integer-bounds shape the placement choose_tries counter
+        uses, where device-reduced retry histograms arrive already
+        bucketed).  Exact when each value equals a declared bound; one
+        call per device fetch instead of O(observations) observe()s."""
+        with self._lock:
+            c = self._get(key)
+            if c.kind != "histogram":
+                raise CounterKindError(
+                    f"perf counter '{self.name}.{key}' is {c.kind}; "
+                    "merge_histogram() needs a histogram"
+                )
+            for i, n in enumerate(counts):
+                if not n:
+                    continue
+                v = values[i] if values is not None else float(i)
+                j = 0
+                while j < len(c.bucket_bounds) and v > c.bucket_bounds[j]:
+                    j += 1
+                c.buckets[j] += int(n)
+                c.sum += v * int(n)
+                c.count += int(n)
+
     def time(self, key: str) -> "_Timer":
         """Context manager recording elapsed seconds into a time_avg."""
         return _Timer(self, key)

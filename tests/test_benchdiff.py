@@ -192,6 +192,88 @@ def test_disappearing_metric_is_surfaced():
     assert "disappeared between rounds" in md
 
 
+def _mk_mc(tmp_path, name, n_devices=8, ok=True, stddev=2.0,
+           skipped=False):
+    p = tmp_path / f"MULTICHIP_{name}.json"
+    p.write_text(json.dumps({
+        "n_devices": n_devices, "rc": 0 if ok else 1, "ok": ok,
+        "skipped": skipped,
+        "tail": f"dryrun_multichip ok: {n_devices} devices, 64 PGs, "
+                f"stddev={stddev:.3f}\n" if ok else "",
+    }))
+    return p
+
+
+def test_multichip_rounds_load_as_their_own_series(tmp_path):
+    paths = [_mk_mc(tmp_path, "r01"), _mk_mc(tmp_path, "r02")]
+    rounds = load_series(paths)
+    assert [r.name for r in rounds] == ["mc-r01", "mc-r02"]
+    mc = rounds[0].record["multichip"]
+    assert mc == {"n_devices": 8, "ok": True, "pgs": 64, "stddev": 2.0}
+    rep = diff_series(rounds)
+    assert [r["round"] for r in rep["multichip_rounds"]] == \
+        ["mc-r01", "mc-r02"]
+    assert rep["rounds"] == []  # not mixed into the BENCH series
+    assert rep["verdict"] == "ok"
+
+
+def test_multichip_mixed_with_bench_series(tmp_path):
+    paths = [_mk_mc(tmp_path, "r01"), _mk_mc(tmp_path, "r02")]
+    rounds = load_series(paths) + [
+        _mk("r01", 1000, 0.05), _mk("r02", 1010, 0.05)]
+    rep = diff_series(rounds)
+    assert len(rep["rounds"]) == 2 and len(rep["multichip_rounds"]) == 2
+    # consecutive deltas never cross series
+    for d in rep["deltas"]:
+        assert d["metric"].startswith("multichip.") == \
+            d["from"].startswith("mc-")
+
+
+def test_multichip_ok_flip_flags(tmp_path):
+    rounds = load_series([
+        _mk_mc(tmp_path, "r01", ok=True),
+        _mk_mc(tmp_path, "r02", ok=False),
+    ])
+    assert not rounds[1].empty  # a failed round is data, not a gap
+    rep = diff_series(rounds)
+    assert rep["verdict"] == "regression"
+    assert any(d["metric"] == "multichip.ok" for d in rep["regressions"])
+
+
+def test_multichip_skipped_is_a_gap(tmp_path):
+    rounds = load_series([
+        _mk_mc(tmp_path, "r01"),
+        _mk_mc(tmp_path, "r02", skipped=True),
+    ])
+    rep = diff_series(rounds)
+    assert any(g["round"] == "mc-r02" for g in rep["gaps"])
+    assert rep["verdict"] == "ok"
+
+
+def test_diagnostics_metrics_are_structural():
+    dg = {"bad_mappings": 0, "retry_exhausted": 0, "collisions": 100,
+          "diag_exact": True, "mapping_identical": True,
+          "default_path_compiles": 0,
+          "tries_histogram": [900, 80, 20, 0, 0]}
+    vals = extract_metrics({"diagnostics": dg})
+    # raw-compared everywhere: bit-determined by map + tunables
+    for name, (v, up, cal_sensitive) in vals.items():
+        assert name.startswith("diagnostics.")
+        assert not cal_sensitive, name
+    assert vals["diagnostics.bad_mappings"] == (0.0, False, False)
+    assert vals["diagnostics.tries_max"] == (2.0, False, False)
+    assert vals["diagnostics.diag_exact"] == (1.0, True, False)
+
+
+def test_diagnostics_bad_mappings_from_zero_flags():
+    r1 = Round("r01", {"diagnostics": {"bad_mappings": 0}})
+    r2 = Round("r02", {"diagnostics": {"bad_mappings": 7}})
+    rep = diff_series([r1, r2])
+    assert rep["verdict"] == "regression"
+    assert any(d["metric"] == "diagnostics.bad_mappings"
+               for d in rep["regressions"])
+
+
 def test_threshold_configurable():
     rounds = [_mk("a", 60000.0, 0.08), _mk("b", 50000.0, 0.08)]  # -17%
     assert diff_series(rounds, threshold=0.10)["verdict"] == "regression"

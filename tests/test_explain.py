@@ -1,0 +1,507 @@
+"""Placement-decision observability: explain replay, device diagnostics
+planes, and the jax-vs-host first-divergence locator.
+
+Three contracts under test:
+
+1. **Recorder purity** — `mapper_ref.do_rule(recorder=...)` emits the
+   full decision log (descents, straw2 draws, rejections) without
+   changing a single mapping byte.
+2. **Plane exactness** — the instrumented device kernel's diagnostics
+   (retry histogram, bad-mapping flags, per-step work vectors)
+   reproduce the host oracle bit-for-bit on a seeded corpus where the
+   plan is diag-exact, and the `--show-choose-tries` unification keeps
+   the tester's histogram identical across backends.
+3. **Triage** — on a deliberately perturbed-tunables map the
+   first-divergence locator pins the exact earliest differing choose
+   step (computed independently here from two host walks).
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import explain, mapper_ref
+from ceph_tpu.crush.soa import build_arrays
+from ceph_tpu.crush.tester import CrushTester, TesterConfig
+from ceph_tpu.crush.types import ITEM_NONE, Tunables
+from ceph_tpu.osd.osdmap import build_hierarchical
+from ceph_tpu.osd.types import PgPool
+from tests.util_maps import build_flat, build_tree, ec_rule, \
+    replicated_rule
+
+N_X = 128
+W32 = [0x10000] * 32
+
+
+def _host_hist(m, ruleno, xs, nr, w, bound=51):
+    m.choose_tries_histogram = [0] * bound
+    for x in xs:
+        mapper_ref.do_rule(m, ruleno, int(x), nr, list(w),
+                           collect_choose_tries=True)
+    return list(m.choose_tries_histogram)
+
+
+@pytest.fixture(scope="module")
+def hier():
+    """(map, ruleno, arrays): chooseleaf firstn over hosts."""
+    m, root = build_tree(np.random.default_rng(7), n_host=8,
+                         osd_per_host=4)
+    ruleno = replicated_rule(m, root, fd_type=1, numrep=3)
+    return m, ruleno, build_arrays(m)
+
+
+@pytest.fixture(scope="module")
+def hier_perturbed():
+    """Same construction, different tunables — the seeded divergence."""
+    m, root = build_tree(
+        np.random.default_rng(7), n_host=8, osd_per_host=4,
+        tunables=Tunables(chooseleaf_vary_r=0, chooseleaf_stable=0),
+    )
+    replicated_rule(m, root, fd_type=1, numrep=3)
+    return m
+
+
+class TestExplainReplay:
+    def test_recorder_never_perturbs(self, hier):
+        m, ruleno, _ = hier
+        for x in range(32):
+            want = mapper_ref.do_rule(m, ruleno, x, 3, W32)
+            ex = explain.explain_seed(m, ruleno, x, 3, W32)
+            assert ex["result"] == want
+
+    def test_event_log_shape(self, hier):
+        m, ruleno, _ = hier
+        ex = explain.explain_seed(m, ruleno, 1234, 3, W32)
+        kinds = [ev["ev"] for ev in ex["events"]]
+        assert kinds[0] == "take"
+        assert "choose" in kinds and "emit" in kinds
+        # one work vector per choose step, matching the final result
+        assert len(ex["steps"]) == 1
+        assert ex["steps"][0] == ex["result"]
+        places = [ev for ev in ex["events"] if ev["ev"] == "place"]
+        # chooseleaf: outer + leaf recursion placements
+        assert len(places) == 3 * 2
+
+    def test_straw2_draws_name_the_winner(self, hier):
+        m, ruleno, _ = hier
+        ex = explain.explain_seed(m, ruleno, 42, 3, W32)
+        draws = [ev for ev in ex["events"] if ev["ev"] == "straw2"]
+        assert draws
+        for ev in draws:
+            best = max(ev["draws"], key=lambda d: d[1])
+            assert best[0] == ev["winner"]
+
+    def test_render_text(self, hier):
+        m, ruleno, _ = hier
+        txt = explain.render_text(
+            explain.explain_seed(m, ruleno, 7, 3, W32), m.item_names)
+        assert "take" in txt and "straw2" in txt and "PLACE" in txt
+        assert "result=" in txt
+
+    def test_explain_pool_pg(self):
+        m = build_hierarchical(4, 4, pool=PgPool(pg_num=64, size=3))
+        ex = explain.explain_pool_pg(m, 0, 5)
+        assert ex["pool"] == 0 and ex["seed"] == 5
+        up, _, _, _ = m.pg_to_up_acting_osds(
+            __import__("ceph_tpu.osd.types", fromlist=["PgId"]).PgId(0, 5))
+        assert ex["up"] == [int(v) for v in up]
+        assert "error" in explain.explain_pool_pg(m, 9, 0)
+        assert "error" in explain.explain_pool_pg(m, 0, 10_000)
+
+
+class TestDeviceHistogram:
+    """--show-choose-tries single source of truth: device planes."""
+
+    def test_hier_bit_identical(self, hier):
+        m, ruleno, A = hier
+        xs = np.arange(N_X, dtype=np.uint32)
+        hist, unres = explain.device_choose_tries(
+            A, ruleno, 3, xs, np.asarray(W32, np.uint32), 51)
+        assert len(unres) == 0
+        assert list(hist) == _host_hist(m, ruleno, xs, 3, W32)
+
+    def test_flat_weighted_bit_identical(self):
+        # out-of-weight rejections in play: half the devices weight 0
+        w = [0x10000 if i % 2 else 0 for i in range(16)]
+        m, root = build_flat(16, weights=[0x10000] * 16)
+        ruleno = replicated_rule(m, root, fd_type=0, numrep=3)
+        A = build_arrays(m)
+        xs = np.arange(N_X, dtype=np.uint32)
+        hist, unres = explain.device_choose_tries(
+            A, ruleno, 3, xs, np.asarray(w, np.uint32), 51)
+        mask = np.ones(N_X, bool)
+        mask[unres] = False
+        host = _host_hist(m, ruleno, xs[mask], 3, w)
+        assert list(hist) == host
+        assert sum(host) > 0
+
+    def test_indep_bit_identical(self):
+        m, root = build_tree(np.random.default_rng(3), n_host=8,
+                             osd_per_host=4)
+        ruleno = ec_rule(m, root, fd_type=0, k_m=6)
+        A = build_arrays(m)
+        xs = np.arange(N_X, dtype=np.uint32)
+        hist, unres = explain.device_choose_tries(
+            A, ruleno, 6, xs, np.asarray(W32, np.uint32), 51)
+        assert len(unres) == 0
+        assert list(hist) == _host_hist(m, ruleno, xs, 6, W32)
+
+    def test_tester_jax_matches_ref_output(self, hier):
+        m, _, _ = hier
+        outs = []
+        for backend in ("jax", "ref"):
+            cfg = TesterConfig(min_x=0, max_x=63, num_rep=3,
+                               show_choose_tries=True, backend=backend)
+            out = io.StringIO()
+            CrushTester(m, cfg, out=out).test()
+            outs.append(out.getvalue())
+        assert outs[0] == outs[1]
+        assert "choose_tries histogram" in outs[0]
+
+
+class TestFirstDivergence:
+    def test_agreement_on_same_map(self, hier):
+        m, ruleno, A = hier
+        xs = np.arange(N_X, dtype=np.uint32)
+        assert explain.first_divergence(m, A, ruleno, xs, 3, W32) is None
+
+    def test_perturbed_tunables_pins_first_step(self, hier,
+                                                hier_perturbed):
+        m, ruleno, A = hier
+        m2 = hier_perturbed
+        xs = np.arange(N_X, dtype=np.uint32)
+        d = explain.first_divergence(m2, A, ruleno, xs, 3, W32)
+        assert d is not None
+        # independent expectation: device(A)==host(m) step-for-step
+        # (asserted above), so the earliest divergence must equal the
+        # earliest host(m)-vs-host(m2) step difference over the batch
+        expect = None
+        for x in xs:
+            s1 = explain.explain_seed(m, ruleno, int(x), 3, W32,
+                                      detail=False)["steps"]
+            s2 = explain.explain_seed(m2, ruleno, int(x), 3, W32,
+                                      detail=False)["steps"]
+            for s in range(max(len(s1), len(s2))):
+                a = (list(s1[s]) if s < len(s1) else []) + [ITEM_NONE] * 3
+                b = (list(s2[s]) if s < len(s2) else []) + [ITEM_NONE] * 3
+                if a[:3] != b[:3]:
+                    if expect is None or s < expect[0]:
+                        expect = (s, int(x))
+                    break
+        assert expect is not None
+        assert d["step"] == expect[0]
+        # the reported seed diverges at that step (host log rides along)
+        assert d["jax"] != d["host"]
+        assert d["host_log"]["x"] == d["x"]
+        assert d["n_divergent"] >= 1
+        assert d["n_checked"] + d["n_unresolved_skipped"] == N_X
+
+    def test_divergence_against_reweighted_map(self, hier):
+        # triage against a *candidate map edit*: same tunables, one
+        # host bucket reweighted on the host side only
+        m, ruleno, A = hier
+        import copy
+
+        m2 = copy.deepcopy(m)
+        b = m2.buckets[min(m2.buckets)]
+        m2.adjust_item_weight(b.items[0], b.weights[0] * 4)
+        xs = np.arange(N_X, dtype=np.uint32)
+        d = explain.first_divergence(m2, A, ruleno, xs, 3, W32)
+        assert d is not None and d["step"] == 0
+
+
+class TestCrushtoolCLI:
+    @pytest.fixture(scope="class")
+    def mapfile(self, tmp_path_factory):
+        from ceph_tpu.crush.codec import encode_crushmap
+
+        m, root = build_tree(np.random.default_rng(7), n_host=8,
+                             osd_per_host=4)
+        replicated_rule(m, root, fd_type=1, numrep=3)
+        fn = tmp_path_factory.mktemp("maps") / "m.bin"
+        fn.write_bytes(encode_crushmap(m))
+        return str(fn)
+
+    def test_explain_command(self, mapfile, capsys):
+        from ceph_tpu.cli.crushtool import main
+
+        assert main(["-i", mapfile, "explain", "42",
+                     "--num-rep", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "explain x=42" in out and "straw2" in out
+
+    def test_explain_pool_seed_form(self, mapfile, capsys):
+        from ceph_tpu.cli.crushtool import main
+
+        assert main(["-i", mapfile, "explain", "1.7",
+                     "--num-rep", "3"]) == 0
+        assert "explain pg 1.7" in capsys.readouterr().out
+
+    def test_locate_divergence_clean(self, mapfile, capsys):
+        from ceph_tpu.cli.crushtool import main
+
+        rc = main(["-i", mapfile, "--locate-divergence", "--max-x",
+                   "63", "--num-rep", "3"])
+        assert rc == 0
+        assert "no divergence" in capsys.readouterr().out
+
+    def test_locate_divergence_against(self, mapfile, tmp_path, capsys):
+        from ceph_tpu.cli.crushtool import main
+        from ceph_tpu.crush.codec import encode_crushmap
+
+        m2, root2 = build_tree(
+            np.random.default_rng(7), n_host=8, osd_per_host=4,
+            tunables=Tunables(chooseleaf_vary_r=0, chooseleaf_stable=0),
+        )
+        replicated_rule(m2, root2, fd_type=1, numrep=3)
+        fn2 = tmp_path / "m2.bin"
+        fn2.write_bytes(encode_crushmap(m2))
+        rc = main(["-i", mapfile, "--locate-divergence", "--against",
+                   str(fn2), "--max-x", "63", "--num-rep", "3"])
+        assert rc == 2
+        out = capsys.readouterr().out
+        assert "DIVERGENCE" in out
+        assert "first differing choose step" in out
+
+
+class TestPoolMapperDiagnose:
+    @pytest.fixture(scope="class")
+    def pool_map(self):
+        return build_hierarchical(8, 4, n_rack=1,
+                                  pool=PgPool(pg_num=256, size=3))
+
+    def test_summary_and_default_path_untouched(self, pool_map):
+        from ceph_tpu import obs
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        pm = PoolMapper(pool_map, 0, overlays=False)
+        ps = np.arange(256, dtype=np.uint32)
+        base = pm.map_batch(ps)  # warm the default executable
+        s = pm.diagnose(ps, record=False)
+        assert s["pgs"] == 256 and s["diag_exact"]
+        assert sum(s["tries_histogram"]) > 0
+        assert s["bad_mappings"] == 0
+        # instrumentation must not have touched the default entry:
+        # the next default pass books 0 compiles, identical bytes
+        j0 = obs.jit_counters()
+        again = pm.map_batch(ps)
+        assert obs.jit_counters()["compiles"] - j0["compiles"] == 0
+        for a, b in zip(base, again):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_histogram_matches_host(self, pool_map):
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        pm = PoolMapper(pool_map, 0, overlays=False)
+        s = pm.diagnose(record=False)
+        pool = pool_map.pools[0]
+        crush = pool_map.crush
+        ruleno = mapper_ref.find_rule(
+            crush, pool.crush_rule, int(pool.type), pool.size)
+        from ceph_tpu.osd.types import PgId
+
+        pps = [pool.raw_pg_to_pps(PgId(0, x))
+               for x in range(pool.pg_num)]
+        host = _host_hist(crush, ruleno, pps, pool.size,
+                          list(pool_map.osd_weight),
+                          bound=s["tries_bound"] + 1)
+        assert s["tries_histogram"] == host
+
+    def test_unresolvable_lanes_masked(self):
+        # 2 hosts, size-3 chooseleaf: the window cannot prove the C
+        # would also fail, so every lane is flagged and EXCLUDED —
+        # garbage planes never masquerade as diagnostics
+        m = build_hierarchical(2, 2, pool=PgPool(pg_num=64, size=3))
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        s = PoolMapper(m, 0, overlays=False).diagnose(record=False)
+        assert s["unresolved"] == 64
+        assert sum(s["tries_histogram"]) == 0
+
+    def test_inexact_plan_books_no_exhaustion(self):
+        # loop-path tunables compile an inexact plan whose tries planes
+        # are all -1 (uninstrumented, NOT exhaustion) — the summary must
+        # say so instead of reporting pgs*lanes bogus exhaustions
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        m = build_hierarchical(
+            4, 4, pool=PgPool(pg_num=64, size=3),
+            tunables=Tunables(chooseleaf_vary_r=0, chooseleaf_stable=0))
+        s = PoolMapper(m, 0, overlays=False).diagnose(record=False)
+        assert s["diag_exact"] is False
+        assert s["retry_exhausted"] == 0
+        assert sum(s["tries_histogram"]) == 0
+
+    def test_record_and_explain_registry(self, pool_map):
+        from ceph_tpu.obs import placement
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        placement.reset()
+        pm = PoolMapper(pool_map, 0, overlays=False)
+        pm.diagnose()
+        dump = placement.dump()
+        assert "pool0" in dump["sources"]
+        assert dump["counters"]["pgs_diagnosed"] >= 256
+        ex = placement.explain("0.5")
+        assert ex.get("pool") == 0 and ex.get("seed") == 5
+        assert "error" in placement.explain("9.0")
+        assert "error" in placement.explain("garbage")
+
+
+class TestPlacementObs:
+    def test_fold_summary(self):
+        from ceph_tpu.obs import placement
+
+        agg: dict = {}
+        placement.fold_summary(agg, {
+            "pgs": 4, "bad_mappings": 1, "tries_histogram": [3, 1],
+            "diag_exact": True})
+        placement.fold_summary(agg, {
+            "pgs": 2, "collisions": 5,
+            "tries_histogram": [1, 0, 2], "diag_exact": True})
+        assert agg["pgs"] == 6 and agg["bad_mappings"] == 1
+        assert agg["collisions"] == 5
+        assert agg["tries_histogram"] == [4, 1, 2]
+        assert agg["diag_exact"] is True
+        placement.fold_summary(agg, {"pgs": 1})  # no diag_exact: False
+        assert agg["diag_exact"] is False
+
+    def test_merge_histogram_counter(self):
+        from ceph_tpu.utils.perf_counters import logger_for
+
+        L = logger_for("placement")
+        before = L.dump()["choose_tries"]["count"]
+        L.merge_histogram("choose_tries", [0, 2, 3])
+        rec = L.dump()["choose_tries"]
+        assert rec["count"] == before + 5
+        with pytest.raises(Exception):
+            L.merge_histogram("pgs_diagnosed", [1])
+
+    def test_prometheus_gauges(self):
+        from ceph_tpu.obs import placement
+
+        placement.reset()
+        assert placement.prometheus_gauges() == ""
+        placement.record("testsrc", {"pgs": 8, "bad_mappings": 3,
+                                     "retry_exhausted": 2})
+        text = placement.prometheus_gauges()
+        assert ('ceph_tpu_placement_source_bad_mappings'
+                '{source="testsrc"} 3') in text
+        assert ('ceph_tpu_placement_source_retry_exhausted'
+                '{source="testsrc"} 2') in text
+        # label values embed user-chosen plan names -> must be escaped
+        placement.record('mgr.a"b\\c\nd', {"bad_mappings": 1})
+        hostile = placement.prometheus_gauges()
+        assert ('{source="mgr.a\\"b\\\\c\\nd"} 1') in hostile
+        assert '\n{' not in hostile
+        placement.reset()
+
+    def test_admin_commands(self):
+        from ceph_tpu.obs import admin_socket, placement
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        placement.reset()
+        m = build_hierarchical(4, 4, pool=PgPool(pg_num=64, size=3))
+        PoolMapper(m, 0, overlays=False).diagnose()
+        bad = json.loads(admin_socket.handle_command("bad dump"))
+        assert "pool0" in bad["sources"]
+        ex = json.loads(admin_socket.handle_command("explain 0.3"))
+        assert ex.get("seed") == 3
+        err = json.loads(admin_socket.handle_command("explain"))
+        assert "error" in err
+
+
+class TestEpochAccounting:
+    def test_sim_diag_history(self):
+        from ceph_tpu.obs import placement
+        from ceph_tpu.sim.failure import ClusterSim
+
+        m = build_hierarchical(4, 4, pool=PgPool(pg_num=64, size=3))
+        sim = ClusterSim(m, diagnostics=True)
+        sim.fail_osd(3)
+        labels = [lab for lab, _ in sim.diag_history]
+        assert labels == ["init", "fail osd.3"]
+        for _, agg in sim.diag_history:
+            assert agg["pgs"] == 64
+            assert agg["diag_exact"] is True
+        assert sim.diag_history[0][1]["epoch"] < \
+            sim.diag_history[1][1]["epoch"]
+        assert "sim" in placement.dump()["sources"]
+
+    def test_sim_diag_off_by_default(self, monkeypatch):
+        from ceph_tpu.sim.failure import ClusterSim
+
+        monkeypatch.delenv("CEPH_TPU_PLACEMENT_DIAG", raising=False)
+        m = build_hierarchical(4, 4, pool=PgPool(pg_num=64, size=3))
+        sim = ClusterSim(m)
+        sim.fail_osd(1)
+        assert sim.diag_history == []
+
+    def test_balancer_execute_accounting(self, monkeypatch):
+        from ceph_tpu.mgr.eval import MappingState
+        from ceph_tpu.mgr.module import Balancer
+        from ceph_tpu.obs import placement
+
+        monkeypatch.setenv("CEPH_TPU_PLACEMENT_DIAG", "1")
+        placement.reset()
+        m = build_hierarchical(
+            4, 4, pool=PgPool(pg_num=64, size=3),
+            weight_fn=lambda i: 0x10000 * (1 + (i % 3)))
+        b = Balancer()
+        plan = b.plan_create("acct", MappingState(m), mode="upmap")
+        rc, _ = b.optimize(plan)
+        assert rc == 0
+        assert b.execute(plan, m) == (0, "")
+        src = placement.dump()["sources"]
+        assert "mgr.acct" in src
+        assert src["mgr.acct"]["pgs"] == 64
+        assert src["mgr.acct"]["epoch"] == m.epoch
+
+    def test_balancer_execute_survives_device_loss(self, monkeypatch):
+        from ceph_tpu.mgr.eval import MappingState
+        from ceph_tpu.mgr.module import Balancer
+        from ceph_tpu.obs import placement
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+        from ceph_tpu.runtime import DeviceLostError
+
+        monkeypatch.setenv("CEPH_TPU_PLACEMENT_DIAG", "1")
+        placement.reset()
+
+        def boom(self, record=True):
+            raise DeviceLostError("wedged")
+
+        monkeypatch.setattr(PoolMapper, "diagnose", boom)
+        m = build_hierarchical(
+            4, 4, pool=PgPool(pg_num=64, size=3),
+            weight_fn=lambda i: 0x10000 * (1 + (i % 3)))
+        b = Balancer()
+        plan = b.plan_create("lost", MappingState(m), mode="upmap")
+        rc, _ = b.optimize(plan)
+        assert rc == 0
+        # the incremental already landed -> diagnostics failure must not
+        # turn a successful execute into an error
+        assert b.execute(plan, m) == (0, "")
+        assert "mgr.lost" not in placement.dump()["sources"]
+
+
+@pytest.mark.slow
+class TestAtScale:
+    def test_large_corpus_agreement_and_histogram(self):
+        pool = PgPool(pg_num=16384, size=3)
+        m = build_hierarchical(16, 8, n_rack=2, pool=pool)
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        pm = PoolMapper(m, 0, overlays=False)
+        s = pm.diagnose(record=False)
+        assert s["pgs"] == 16384 and s["diag_exact"]
+        assert sum(s["tries_histogram"]) >= 16384 * 3
+        crush = m.crush
+        ruleno = mapper_ref.find_rule(
+            crush, pool.crush_rule, int(pool.type), pool.size)
+        A = build_arrays(crush)
+        xs = (np.arange(4096, dtype=np.uint32) * 2654435761) % (2**31)
+        d = explain.first_divergence(
+            crush, A, ruleno, xs, 3, list(m.osd_weight))
+        assert d is None

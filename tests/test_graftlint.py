@@ -208,6 +208,20 @@ def test_counter_decl_knows_quantile_kind(tmp_path):
     assert len(v) == 1 and v[0].line == 7 and "'lat_mist'" in v[0].message
 
 
+def test_counter_decl_merge_histogram_update(tmp_path):
+    # merge_histogram (the placement group's device-folded histogram
+    # update) is an update like inc/observe: a declared histogram key
+    # resolves, an undeclared one fires
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "L = obs.logger_for('fixg')\n"
+        "L.add_histogram('choose_tries', [0, 1, 2], 'retries')\n"
+        "L.merge_histogram('choose_tries', [5, 1, 0])\n"
+        "L.merge_histogram('chose_tries', [5, 1, 0])\n"
+    ), "counter-decl")
+    assert len(v) == 1 and v[0].line == 5 and "'chose_tries'" in v[0].message
+
+
 def test_counter_decl_observe_and_time(tmp_path):
     v = lint(tmp_path, (
         "from ceph_tpu import obs\n"

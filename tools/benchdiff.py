@@ -47,7 +47,10 @@ from pathlib import Path
 # the BENCH record shape version bench.py writes and this reader speaks.
 # v1: everything before the stamp existed (r01..r07-era records).
 # v2: adds schema_version, executables, quantiles, benchdiff sections.
-SCHEMA_VERSION = 2
+# v3: adds the placement `diagnostics` section (bad mappings, retry
+#     histogram, default-path non-perturbation proof) and recognizes
+#     MULTICHIP_r*.json trajectory wrappers as their own series.
+SCHEMA_VERSION = 3
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -117,6 +120,30 @@ def _from_partial(raw: dict) -> dict:
     return rec
 
 
+_MC_TAIL_RE = re.compile(
+    r"(\d+) devices, (\d+) PGs, stddev=([\d.]+)")
+
+
+def _from_multichip(raw: dict) -> dict:
+    """Normalize a MULTICHIP_r*.json wrapper ({n_devices, rc, ok,
+    skipped, tail}) into {"multichip": {...}} — its own trajectory,
+    diffed separately from the BENCH series (a multichip dry-run and a
+    bench run share no metrics).  All structural: device counts, the
+    sharded==unsharded verdict, and the rebalance stddev the dry-run
+    prints are deterministic, never hardware-scaled."""
+    mc: dict = {}
+    nd = raw.get("n_devices")
+    if isinstance(nd, (int, float)) and not isinstance(nd, bool):
+        mc["n_devices"] = nd
+    if isinstance(raw.get("ok"), bool):
+        mc["ok"] = raw["ok"]
+    m = _MC_TAIL_RE.search(raw.get("tail") or "")
+    if m:
+        mc["pgs"] = int(m.group(2))
+        mc["stddev"] = float(m.group(3))
+    return {"multichip": mc} if mc else {}
+
+
 def load_round(path: str | Path) -> Round:
     p = Path(path)
     m = _ROUND_RE.search(p.stem)
@@ -130,6 +157,17 @@ def load_round(path: str | Path) -> Round:
     if not isinstance(raw, dict):
         r = Round(name, {}, str(p))
         r.notes.append("not a JSON object")
+        return r
+    if "n_devices" in raw and "tail" in raw:  # MULTICHIP wrapper
+        name = f"mc-{name}"
+        if raw.get("skipped"):
+            r = Round(name, {}, str(p))
+            r.notes.append("multichip round skipped")
+            return r
+        r = Round(name, _from_multichip(raw), str(p))
+        if r.empty:
+            r.notes.append(
+                f"multichip round unparseable (rc={raw.get('rc')})")
         return r
     if "parsed" in raw:  # retrieval wrapper
         rec = raw.get("parsed") or {}
@@ -163,6 +201,9 @@ def default_series_paths(root: str | Path = ".") -> list[Path]:
     partial = root / "BENCH_partial.json"
     if partial.exists():
         out.append(partial)
+    # the MULTICHIP trajectory rides along; diff_series partitions it
+    # into its own series (different files, different metrics)
+    out.extend(sorted(root.glob("MULTICHIP_r*.json")))
     return out
 
 
@@ -225,28 +266,41 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
     if isinstance(bs, dict):
         put("perf.balancer.build_state_avgtime", bs.get("avgtime"),
             False, True)
+    # placement diagnostics (v3): decision tallies over the bench map
+    # are bit-determined by map + tunables, so they compare raw
+    # everywhere — a moving bad_mappings/collisions count is semantic
+    # drift in the mapping stack, not hardware variance
+    dg = rec.get("diagnostics") or {}
+    put("diagnostics.bad_mappings", dg.get("bad_mappings"), False, False)
+    put("diagnostics.retry_exhausted", dg.get("retry_exhausted"),
+        False, False)
+    put("diagnostics.collisions", dg.get("collisions"), False, False)
+    put("diagnostics.default_path_compiles",
+        dg.get("default_path_compiles"), False, False)
+    for bkey, bval in (("diag_exact", dg.get("diag_exact")),
+                       ("mapping_identical", dg.get("mapping_identical"))):
+        if isinstance(bval, bool):
+            out[f"diagnostics.{bkey}"] = (float(bval), True, False)
+    hist = dg.get("tries_histogram")
+    if isinstance(hist, list) and hist:
+        put("diagnostics.tries_max",
+            max((i for i, v in enumerate(hist) if v), default=0),
+            False, False)
+    # multichip trajectory (normalized MULTICHIP_r*.json wrappers)
+    mc = rec.get("multichip") or {}
+    put("multichip.n_devices", mc.get("n_devices"), True, False)
+    put("multichip.pgs", mc.get("pgs"), True, False)
+    put("multichip.stddev", mc.get("stddev"), False, False)
+    if isinstance(mc.get("ok"), bool):
+        out["multichip.ok"] = (float(mc["ok"]), True, False)
     return out
 
 
 # -- diffing ----------------------------------------------------------------
 
-def diff_series(rounds: list[Round],
-                threshold: float = DEFAULT_THRESHOLD) -> dict:
-    """Per-metric deltas between consecutive non-empty rounds, with
-    regressions/improvements beyond `threshold`.  Returns the JSON
-    report (see render_markdown for the human shape)."""
-    usable = [r for r in rounds if not r.empty]
-    gaps = [
-        {"round": r.name, "notes": r.notes}
-        for r in rounds if r.empty
-    ]
-    # reference calibration: the latest calibrated round — "would the
-    # series regress if every round had run on the newest container"
-    ref_cal = None
-    for r in reversed(usable):
-        if r.calibration:
-            ref_cal = r.calibration
-            break
+def _series_metrics(usable: list[Round],
+                    ref_cal: float | None) -> tuple[list, list]:
+    """(metrics, per_round) rows for one series of non-empty rounds."""
     per_round = []
     metrics: list[dict] = []  # parallel to usable
     for r in usable:
@@ -266,6 +320,13 @@ def diff_series(rounds: list[Round],
             "calibration_gbps": cal,
             "notes": r.notes,
         })
+    return metrics, per_round
+
+
+def _series_deltas(metrics: list[dict],
+                   threshold: float) -> tuple[list, list, list, list]:
+    """(deltas, regressions, improvements, missing) between consecutive
+    rounds of one metrics series."""
     deltas, regressions, improvements, missing = [], [], [], []
     for prev, cur in zip(metrics, metrics[1:]):
         # a metric that disappears between rounds is surfaced, not
@@ -332,11 +393,53 @@ def diff_series(rounds: list[Round],
                 regressions.append(d)
             elif good:
                 improvements.append(d)
+    return deltas, regressions, improvements, missing
+
+
+def diff_series(rounds: list[Round],
+                threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Per-metric deltas between consecutive non-empty rounds, with
+    regressions/improvements beyond `threshold`.  MULTICHIP rounds
+    partition into their own series (multichip.* metrics, reported
+    under `multichip_rounds`) but merge into the same regression lists
+    and verdict.  Returns the JSON report (see render_markdown for the
+    human shape)."""
+
+    def is_mc(r: Round) -> bool:
+        return r.name.startswith("mc-") or "multichip" in r.record
+
+    main = [r for r in rounds if not is_mc(r)]
+    mc_rounds = [r for r in rounds if is_mc(r)]
+    usable = [r for r in main if not r.empty]
+    gaps = [
+        {"round": r.name, "notes": r.notes}
+        for r in rounds if r.empty
+    ]
+    # reference calibration: the latest calibrated round — "would the
+    # series regress if every round had run on the newest container"
+    ref_cal = None
+    for r in reversed(usable):
+        if r.calibration:
+            ref_cal = r.calibration
+            break
+    metrics, per_round = _series_metrics(usable, ref_cal)
+    deltas, regressions, improvements, missing = _series_deltas(
+        metrics, threshold)
+    mc_per_round: list = []
+    if mc_rounds:
+        mc_metrics, mc_per_round = _series_metrics(
+            [r for r in mc_rounds if not r.empty], None)
+        d2, r2, i2, m2 = _series_deltas(mc_metrics, threshold)
+        deltas += d2
+        regressions += r2
+        improvements += i2
+        missing += m2
     return {
         "tool": "benchdiff",
         "schema_version": SCHEMA_VERSION,
         "threshold": threshold,
         "rounds": per_round,
+        "multichip_rounds": mc_per_round,
         "gaps": gaps,
         "calibration_ref_gbps": ref_cal,
         "deltas": deltas,
@@ -376,6 +479,16 @@ def render_markdown(report: dict) -> str:
             f"| {r['round']} | - | - | - | GAP: "
             f"{'; '.join(r['notes'])} |"
         )
+    mc = report.get("multichip_rounds") or []
+    if mc:
+        lines.append("")
+        lines.append("## Multichip trajectory")
+        lines.append("| round | notes |")
+        lines.append("|-------|-------|")
+        for r in mc:
+            lines.append(
+                f"| {r['round']} | {'; '.join(r['notes']) or '-'} |"
+            )
     for title, rows in (("Regressions", report["regressions"]),
                         ("Improvements", report["improvements"])):
         lines.append("")

@@ -37,7 +37,7 @@ from tools.graftlint.engine import (
 
 DECLARES = ("add_u64", "add_avg", "add_time_avg", "add_histogram",
             "add_quantile")
-UPDATES = ("inc", "observe", "time", "set")
+UPDATES = ("inc", "observe", "time", "set", "merge_histogram")
 
 
 def _logger_for_group(node: ast.AST, module: Module) -> str | None:
