@@ -64,11 +64,20 @@ the output), and `--selftest` additionally runs the differ over a
 frozen fixture series and fails unless the seeded regression is
 flagged.
 
+A `lifetime` stage runs a >=500-epoch seeded chaos scenario through
+ceph_tpu.sim.lifetime (failure/churn/growth as real Incremental chains,
+device-side accounting, invariant checks) and records epochs/s,
+simulated cluster-years per wallclock hour, and three robustness
+proofs: injected device loss degrades with an unchanged digest, an
+interrupted run resumes to the straight run's digest, and steady
+epochs book 0 compiles.
+
 Env knobs: BENCH_PGS, BENCH_OSDS, BENCH_BASELINE_PGS, BENCH_EC_MB,
 BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU,
 BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
 second mapping config, selftest), BENCH_BAL_PGS/_OSDS/_COMPAT_ITERS
-(balancer stage), plus the CEPH_TPU_FAULTS / CEPH_TPU_LADDER /
+(balancer stage), BENCH_LIFETIME_SCENARIO/_EPOCHS/_CK (lifetime
+stage), plus the CEPH_TPU_FAULTS / CEPH_TPU_LADDER /
 CEPH_TPU_INIT_* runtime knobs and CEPH_TPU_EC_STRATEGY (forces one
 ec.jax_backend strategy; the ec_jax stage measures all of them anyway).
 """
@@ -737,6 +746,106 @@ def bench_clay() -> dict:
     }
 
 
+DEFAULT_LIFETIME_SCENARIO = (
+    "hosts=4,osds_per_host=3,racks=2,pgs=32,ec=2+1,ec_pgs=16,"
+    "chunk=256,balance_every=96,balance_max=4,spotcheck_every=48,"
+    "checkpoint_every=128,seed=11,p_death=0.03,p_reweight=0.05,"
+    "max_pools=3,max_pgs=64,max_expand=1,new_pool_pgs=32"
+)
+
+
+def bench_lifetime(h) -> dict:
+    """The `lifetime` stage: a >=500-epoch seeded chaos scenario through
+    ceph_tpu.sim.lifetime, measuring epochs/s and simulated
+    cluster-years per wallclock hour, with three robustness proofs in
+    the record:
+
+    - an injected mid-run device loss (`epoch_apply=lost`) must degrade
+      that epoch's accounting to the bit-exact host mapper — provenance
+      recorded, trajectory digest UNCHANGED;
+    - an interrupted run resumed from its runtime.Checkpoint must land
+      on the same final digest as the uninterrupted run.  The straight
+      run checkpoints near its end; that file is snapshotted as the
+      interrupt point and a FRESH engine resumes from it (full
+      state round-trip through the serialized checkpoint) — proving
+      resume without paying a second whole lifetime;
+    - steady epochs (structure unchanged) must book 0 compiles
+      (trace-once, `pipe_cache_*`/JitAccount counters), and the
+      invariant checker must stay at 0 violations.
+    """
+    import shutil
+
+    from ceph_tpu.runtime import faults
+    from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+    spec = os.environ.get("BENCH_LIFETIME_SCENARIO",
+                          DEFAULT_LIFETIME_SCENARIO)
+    epochs = int(os.environ.get("BENCH_LIFETIME_EPOCHS", 510))
+    sc = Scenario.parse(spec)
+    sc.epochs = epochs
+    loss_epoch = max(2, epochs // 2 + 1)
+    stop = max(1, epochs - 10)  # the snapshotted interrupt point
+    ck = _HERE / os.environ.get("BENCH_LIFETIME_CK",
+                                "BENCH_lifetime_ck.json")
+    ck2 = ck.with_suffix(".snap.json")
+    ck.unlink(missing_ok=True)
+    ck2.unlink(missing_ok=True)
+    jit0 = _jit_counters()
+
+    # run A: straight through, with a device loss injected mid-run and
+    # a checkpoint snapshot taken at `stop`
+    faults.arm(f"epoch_apply.{loss_epoch}", "lost", "bench", 1)
+    try:
+        with obs.span("bench.lifetime", phase="straight",
+                      epochs=epochs):
+            sim_a = LifetimeSim(sc, backend="jax", checkpoint=str(ck))
+            sim_a.run(stop_after=stop)  # checkpoints at `stop`
+            shutil.copy(ck, ck2)
+            out_a = sim_a.run()  # straight on to the end
+    finally:
+        # only OUR fault: disarm_all would wipe env-armed faults aimed
+        # at the later (lower-priority) stages of this same worker
+        faults.disarm(f"epoch_apply.{loss_epoch}")
+    h.progress({"straight": {k: out_a[k] for k in
+                             ("epochs", "digest", "wall_s")}})
+
+    # run B: a fresh engine resumed from the snapshotted interrupt
+    with obs.span("bench.lifetime", phase="resumed",
+                  epochs=epochs - stop):
+        sim_b = LifetimeSim(sc, backend="jax", checkpoint=str(ck2),
+                            resume=True)
+        out_b = sim_b.run()
+    ck.unlink(missing_ok=True)
+    ck2.unlink(missing_ok=True)
+
+    tr = out_a["trace_once"]
+    return {
+        "scenario": sc.spec(),
+        "epochs": out_a["epochs"],
+        "digest": out_a["digest"],
+        "epochs_per_sec": out_a["epochs_per_sec"],
+        "cluster_years_per_hour": out_a["cluster_years_per_hour"],
+        "sim_years": out_a["sim_years"],
+        "events": out_a["events"],
+        "invariant_violations": out_a["invariant_violations"],
+        "violations": out_a["violations"][:5],
+        "degraded_epochs": out_a["degraded_epochs"],
+        "report": out_a["report"],
+        "trace_once": tr,
+        "steady_compiles": tr["steady_compiles"],
+        "jit_compiles_per_epoch": out_a["jit_compiles_per_epoch"],
+        "at_risk_pg_seconds": round(
+            out_a["report"]["at_risk_pg_seconds"], 3),
+        # robustness proofs
+        "device_loss_fallbacks":
+            out_a["provenance"]["device_loss_fallbacks"],
+        "device_loss_epoch": loss_epoch,
+        "resume_from": out_b.get("resumed_from"),
+        "resume_digest_match": out_b["digest"] == out_a["digest"],
+        "jit": _jit_delta(jit0),
+    }
+
+
 PROBE_TIMEOUT_S = float(os.environ.get(
     "BENCH_PROBE_TIMEOUT", os.environ.get("BENCH_INIT_TIMEOUT", 120)))
 
@@ -860,6 +969,12 @@ def worker() -> None:
 
     sched.add("crushtool_1k_32", cfg1, priority=80, est_s=30,
               min_budget_s=25)
+    # the lifetime chaos scenario outranks the big mapping configs: a
+    # pathological headline run must not starve the robustness torture
+    # test, but the soft timeout bounds it so a wedged epoch cannot
+    # starve the rebalance/headline stages behind it either
+    sched.add("lifetime", lambda h: bench_lifetime(h), priority=75,
+              est_s=230, min_budget_s=180, soft_timeout_s=330)
     sched.add("testmappgs_100k_1k", cfg2, priority=70, est_s=60,
               min_budget_s=40)
     # soft timeout: the balancer stage runs AHEAD of the north-star
@@ -957,6 +1072,8 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         out["stages_done"] = list(stages["stages_done"])
     if "balancer" in stages:
         out["balancer"] = _strip_perf(stages["balancer"])
+    if "lifetime" in stages:
+        out["lifetime"] = _strip_perf(stages["lifetime"])
     if "executables" in stages:
         out["executables"] = stages["executables"]
     q = _quantile_section(stages.get("perf") or {})
@@ -1123,9 +1240,15 @@ SELFTEST_ENV = {
     "BENCH_BAL_PGS": "1024", "BENCH_BAL_OSDS": "64",
     "BENCH_BAL_COMPAT_ITERS": "1",
     "BENCH_REPS": "1",
-    # generous deadline: the <60s bound comes from the workload being
-    # tiny, not from budget-skipping stages (skips would fail the assert)
-    "BENCH_DEADLINE_S": "240", "BENCH_HEADLINE_RESERVE": "20",
+    # the acceptance floor: a >=500-epoch seeded chaos scenario with an
+    # injected mid-run device loss and an interrupt+resume digest proof
+    "BENCH_LIFETIME_EPOCHS": "510",
+    "BENCH_LIFETIME_CK": "BENCH_selftest_lifetime_ck.json",
+    # generous deadline: the bound comes from the workloads being tiny,
+    # not from budget-skipping stages (skips would fail the assert); the
+    # 510-epoch lifetime scenario alone is ~200s of real dispatches on a
+    # throttled 2-thread container
+    "BENCH_DEADLINE_S": "480", "BENCH_HEADLINE_RESERVE": "20",
     # the survivability path under test: the configured-platform probe
     # hangs; the watchdog kills it in ~2s and the ladder degrades to cpu
     "CEPH_TPU_FAULTS": "init.auto=hang:600",
@@ -1135,8 +1258,8 @@ SELFTEST_ENV = {
 }
 
 SELFTEST_STAGES = (
-    "init", "ec_jax", "ec_clay", "crushtool_1k_32", "testmappgs_100k_1k",
-    "balancer", "rebalance", "headline",
+    "init", "ec_jax", "ec_clay", "crushtool_1k_32", "lifetime",
+    "testmappgs_100k_1k", "balancer", "rebalance", "headline",
 )
 
 
@@ -1224,12 +1347,14 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
 
 
 def selftest() -> int:
-    """<60s CPU-only survivability check: inject a TPU-init hang, then
-    require that EVERY stage (including a miniature rebalance) completes
-    and the output carries the degradation provenance.  Exercises probe
-    watchdog -> ladder descent -> scheduler -> checkpoint end to end; a
-    regression in any of those fails this fast instead of blanking the
-    next real benchmark run."""
+    """CPU-only survivability check: inject a TPU-init hang, then
+    require that EVERY stage (including a miniature rebalance and the
+    510-epoch lifetime chaos scenario) completes and the output carries
+    the degradation provenance.  Exercises probe watchdog -> ladder
+    descent -> scheduler -> checkpoint end to end; a regression in any
+    of those fails this fast instead of blanking the next real
+    benchmark run.  The lifetime stage makes this a minutes-scale gate
+    on a throttled container (bounded by the 480s worker deadline)."""
     t0 = time.time()
     env = dict(os.environ)
     env.pop("BENCH_REQUIRE_TPU", None)
@@ -1240,13 +1365,13 @@ def selftest() -> int:
     try:
         proc = subprocess.run(
             [sys.executable, str(Path(__file__).resolve())],
-            env=env, capture_output=True, text=True, timeout=300,
+            env=env, capture_output=True, text=True, timeout=560,
         )
     except subprocess.TimeoutExpired as e:
         # the one failure mode that must still produce a verdict JSON:
         # the survivability path itself regressed into a wedge
         problems.append(
-            "selftest run wedged past 300s (survivability path "
+            "selftest run wedged past 560s (survivability path "
             f"regression?): {str(e.stderr)[-300:] if e.stderr else ''}"
         )
     else:
@@ -1291,6 +1416,30 @@ def selftest() -> int:
             problems.append(
                 "default-path mapping not bit-identical after the "
                 "instrumented run")
+        # lifetime acceptance gates: >=500 chaos epochs, invariants
+        # clean, trace-once across epoch applies, device loss degraded
+        # not fatal, and interrupt+resume bit-identical
+        lf = out.get("lifetime") or {}
+        if lf.get("epochs", 0) < 500:
+            problems.append(
+                f"lifetime ran {lf.get('epochs')} epochs (wanted >=500)")
+        if lf.get("invariant_violations", -1) != 0:
+            problems.append(
+                f"lifetime invariant violations: "
+                f"{lf.get('invariant_violations')} "
+                f"({(lf.get('violations') or ['?'])[:2]})")
+        if lf.get("steady_compiles", -1) != 0:
+            problems.append(
+                f"lifetime steady epochs booked "
+                f"{lf.get('steady_compiles')} compile(s) — epoch apply "
+                "is not trace-once")
+        if not lf.get("device_loss_fallbacks"):
+            problems.append(
+                "lifetime injected device loss did not degrade "
+                "(no fallback recorded)")
+        if not lf.get("resume_digest_match"):
+            problems.append(
+                "lifetime resume digest != straight-run digest")
     lint = _selftest_graftlint(problems)
     execs = _selftest_executables(out, problems)
     bdiff = _selftest_benchdiff(problems)
@@ -1309,6 +1458,13 @@ def selftest() -> int:
             if k in ("pgs", "bad_mappings", "retry_exhausted",
                      "collisions", "diag_exact", "default_path_compiles",
                      "mapping_identical")
+        } or None,
+        "lifetime": {
+            k: v for k, v in (out.get("lifetime") or {}).items()
+            if k in ("epochs", "invariant_violations", "steady_compiles",
+                     "device_loss_fallbacks", "resume_digest_match",
+                     "epochs_per_sec", "cluster_years_per_hour",
+                     "degraded_epochs")
         } or None,
         "benchdiff": bdiff,
     }

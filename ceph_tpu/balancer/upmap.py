@@ -79,6 +79,11 @@ def _choose_type_stack(
             leaves: list[set[int]] = [set() for _ in range(fanout)]
             for pos in range(fanout):
                 if type_ > 0:
+                    if tmpi >= len(orig):
+                        # reference "end of orig, break 1"
+                        # (CrushWrapper.cc:3906): a degraded mapping is
+                        # shorter than the rule's fanout product
+                        break
                     item = get_parent_of_type(
                         crush, orig[tmpi], type_, ruleno
                     )

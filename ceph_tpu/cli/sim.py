@@ -1,0 +1,109 @@
+"""sim — drive the cluster-lifetime chaos simulator from the shell.
+
+    python -m ceph_tpu.cli.sim run [--scenario SPEC] [--epochs N]
+        [--backend jax|ref] [--checkpoint PATH] [--resume]
+        [--stop-after N] [--json]
+    python -m ceph_tpu.cli.sim digest [--scenario SPEC] ...
+
+`run` evolves one cluster through the scenario's epochs (see
+`ceph_tpu.sim.lifetime` for the scenario syntax), printing a summary —
+or, with `--json`, the full machine-readable run record on one line.
+Exit status: 0 clean, 1 when any epoch invariant was violated.
+
+`digest` runs the same engine but prints only the final trajectory
+digest — the bit-identical-replay witness two runs (or a killed run
+plus `--resume`) are compared by.
+
+Crash safety: with `--checkpoint`, state flushes atomically every
+`checkpoint_every` epochs; after a kill (or an armed
+`CEPH_TPU_FAULTS="lifetime_step.<epoch>=exit:9"`), re-running with
+`--resume` continues from the checkpointed epoch and must land on the
+same final digest an uninterrupted run produces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+
+def _parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.cli.sim",
+        description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument("cmd", choices=("run", "digest"))
+    ap.add_argument("--scenario", default=None,
+                    help="comma-separated key=value scenario overrides "
+                         "(ceph_tpu.sim.lifetime.Scenario fields)")
+    ap.add_argument("--epochs", type=int, default=None,
+                    help="override the scenario's epoch count")
+    ap.add_argument("--backend", default="jax", choices=("jax", "ref"),
+                    help="device accounting (jax, host-degradable) or "
+                         "host-only (ref)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="atomic state file for crash-safe runs")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue from --checkpoint's last state")
+    ap.add_argument("--stop-after", type=int, default=None,
+                    help="stop after this epoch (checkpoint + exit; "
+                         "the resume test's controlled interrupt)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full run record as one JSON line")
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _parser().parse_args(argv)
+    if args.resume and not args.checkpoint:
+        print("--resume needs --checkpoint", file=sys.stderr)
+        return 2
+    spec = args.scenario
+    if args.resume and spec is None:
+        # resume without --scenario adopts the checkpoint's pinned
+        # scenario (the README flow); a missing/fresh checkpoint just
+        # falls back to defaults, exactly like a non-resume run
+        try:
+            state = json.loads(
+                open(args.checkpoint).read()).get("lifetime") or {}
+            spec = state.get("scenario")
+        except (OSError, ValueError):
+            pass
+    sc = Scenario.parse(spec)
+    if args.epochs is not None:
+        sc.epochs = args.epochs
+    sim = LifetimeSim(sc, backend=args.backend,
+                      checkpoint=args.checkpoint, resume=args.resume)
+    out = sim.run(stop_after=args.stop_after)
+    if args.cmd == "digest":
+        print(out["digest"])
+    elif args.json:
+        print(json.dumps(out))
+    else:
+        prov = out["provenance"]
+        print(f"epochs          {out['epochs']} "
+              f"(map epoch {out['map_epoch']})")
+        print(f"digest          {out['digest']}")
+        print(f"sim time        {out['sim_seconds']:.0f}s "
+              f"({out['sim_years']:.4f} cluster-years)")
+        print(f"rate            {out['epochs_per_sec']} epochs/s, "
+              f"{out['cluster_years_per_hour']} cluster-years/hour")
+        print(f"events          {out['events']}")
+        print(f"movement        {out['report']}")
+        print(f"degraded epochs {out['degraded_epochs']}")
+        print(f"trace-once      {out['trace_once']}")
+        print(f"backend         {prov['backend']} "
+              f"({prov['device_loss_fallbacks']} device-loss "
+              f"degradations)")
+        print(f"invariants      {out['invariant_violations']} "
+              f"violation(s)")
+        for v in out["violations"]:
+            print(f"  VIOLATION {v}")
+    return 1 if out["invariant_violations"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

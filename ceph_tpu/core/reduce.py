@@ -92,6 +92,35 @@ def value_histogram(vals, max_value: int, extra_mask=None,
     )[: max_value + 1]
 
 
+def duplicate_rows(rows):
+    """Per-row flag: the row carries the same OSD in two occupied lanes
+    (the up/acting-set invariant a valid mapping can never violate —
+    CRUSH rejects collisions, upmap refuses duplicate targets).  [N, W]
+    -> bool [N]."""
+    valid = valid_lanes(rows)
+    eq = (rows[:, :, None] == rows[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    w = rows.shape[-1]
+    upper = jnp.triu(jnp.ones((w, w), bool), k=1)
+    return (eq & upper[None, :, :]).any(axis=(1, 2))
+
+
+def moved_in_lanes(before, after):
+    """Per-lane flag: occupied `after` lanes whose OSD is not a member
+    of the same row in `before` (the elementwise form misplaced_lanes
+    sums).  [N, W] x [N, W] -> bool [N, W]."""
+    member = (after[:, :, None] == before[:, None, :]).any(axis=2)
+    return ~member & valid_lanes(after)
+
+
+def changed_rows(before, after):
+    """Per-row flag: the row's occupied-OSD multiset changed between the
+    two mappings (content-based — primary reordering alone does not
+    count).  [N, W] x [N, W] -> bool [N]."""
+    return moved_in_lanes(before, after).any(axis=-1) \
+        | moved_in_lanes(after, before).any(axis=-1)
+
+
 def misplaced_lanes(before, after, extra_mask=None):
     """Count of occupied `after` lanes whose OSD is not a member of the
     same row in `before` — the replica-slot form of the reference's
@@ -99,8 +128,7 @@ def misplaced_lanes(before, after, extra_mask=None):
     elementwise not-a-member == set difference.  [N, W] x [N, W] -> i64
     scalar (device); chunk the N axis host-side if W is wide enough for
     the [N, W, W] compare to matter."""
-    member = (after[:, :, None] == before[:, None, :]).any(axis=2)
-    moved = ~member & valid_lanes(after)
+    moved = moved_in_lanes(before, after)
     if extra_mask is not None:
         moved = moved & extra_mask
     return jnp.sum(moved.astype(jnp.int64))

@@ -69,6 +69,10 @@ SPANS: dict[str, str] = {
     # runtime/
     "runtime.acquire_backend": "ladder descent to a healthy backend",
     "runtime.probe": "one watchdogged device preflight probe",
+    # sim/lifetime.py
+    "sim.epoch": "one lifetime epoch: Incremental apply + remap + "
+                 "device accounting + invariant checks",
+    "bench.lifetime": "lifetime bench stage body",
     # cli/
     "daemon.selftest": "daemon CLI miniature workload",
     # tools/perf_probe.py
@@ -82,6 +86,7 @@ INSTANTS: dict[str, str] = {
     "stage.overrun": "a stage was abandoned by the watchdog",
     "runtime.acquired": "backend acquisition finished",
     "sharded.make_mesh": "device mesh construction",
+    "sim.checkpoint": "a lifetime-sim checkpoint was flushed",
 }
 
 COUNTERS: dict[str, str] = {

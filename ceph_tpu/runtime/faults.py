@@ -11,7 +11,7 @@ TPU to wedge.
 
 Spec syntax (env `CEPH_TPU_FAULTS`, comma-separated):
 
-    point[.qualifier]=action[:arg][xN]
+    point[.qualifier]=action[:arg][@pP][xN]
 
     CEPH_TPU_FAULTS="init.tpu=hang:600"        # TPU init hangs 600s
     CEPH_TPU_FAULTS="init.tpu=fail:ENOLINK x2" # first 2 probes raise
@@ -19,6 +19,8 @@ Spec syntax (env `CEPH_TPU_FAULTS`, comma-separated):
     CEPH_TPU_FAULTS="map_batch=lost x1"        # device loss, once
     CEPH_TPU_FAULTS="stage_end.ec_jax=exit:3"  # die after a checkpoint
     CEPH_TPU_FAULTS="stage.headline=overrun:9" # stage overruns 9s
+    CEPH_TPU_FAULTS="epoch_apply=lost@p0.3x2"  # flaky: each hit fires
+                                               # with prob 0.3, 2 firings
 
 Actions:
 
@@ -36,6 +38,16 @@ Actions:
 Counts decrement in-process; a respawned worker re-arms from the env,
 which is exactly what the retry-until-healthy tests want.
 
+`@pP` arms the fault *probabilistically*: each hit fires with
+probability P (a float in (0, 1]), drawn from a deterministic
+`numpy.random.default_rng` seeded from the spec itself — the same armed
+spec produces the same fire/skip sequence in every process, so chaos
+schedules (sim/lifetime.py) can arm flaky faults and still replay
+bit-identically.  A skipped (not-fired) hit consumes no `xN` budget.
+When both a qualified and a bare fault are armed, the most specific
+match decides alone — a probabilistic skip does not fall through to the
+bare entry.
+
 Fault points are cheap when disarmed: one dict lookup against a dict
 that is empty in production.  Every firing is recorded in the `runtime`
 perf-counter group and as an `obs` instant event, so an armed fault can
@@ -45,6 +57,7 @@ never silently shape a benchmark number.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
@@ -64,6 +77,10 @@ FAULT_POINTS: dict[str, str] = {
     "map_batch": "mid-batch device dispatch in the mapping pipeline",
     "stage": "scheduler stage body start (qualifier: stage name)",
     "stage_end": "after a stage checkpoints (qualifier: stage name)",
+    "epoch_apply": "lifetime-sim per-pool device accounting dispatch "
+                   "(qualifier: epoch number)",
+    "lifetime_step": "lifetime-sim step start, before the epoch's "
+                     "Incremental is built (qualifier: epoch number)",
 }
 
 _log = subsys_logger("runtime")
@@ -104,15 +121,40 @@ def looks_like_device_loss(exc: BaseException) -> bool:
 
 
 class _Fault:
-    __slots__ = ("action", "arg", "remaining")
+    __slots__ = ("action", "arg", "remaining", "p", "key", "_rng")
 
-    def __init__(self, action: str, arg: str, remaining: int):
+    def __init__(self, action: str, arg: str, remaining: int,
+                 p: float = 1.0, key: str = ""):
         self.action = action
         self.arg = arg
         self.remaining = remaining  # <0 = unlimited
+        self.p = p  # firing probability per hit (1.0 = always)
+        self.key = key  # the armed point[.qual], part of the rng seed
+        self._rng = None
+
+    def draw(self) -> bool:
+        """Deterministic per-hit firing decision for `@pP` faults: the
+        rng seeds from the fault's own full spec item — point INCLUDED,
+        so two points armed with the same action/arg/p still get
+        independent fire/skip sequences — and every process arming the
+        same spec sees the same sequence."""
+        if self.p >= 1.0:
+            return True
+        if self._rng is None:
+            import zlib
+
+            import numpy as np
+
+            seed = zlib.crc32(
+                f"{self.key}={self.action}:{self.arg}@p{self.p}".encode()
+            )
+            self._rng = np.random.default_rng(seed)
+        return float(self._rng.random()) < self.p
 
 
 _armed: dict[str, _Fault] = {}
+
+_P_RE = re.compile(r"@p([0-9.]+)$")
 
 
 def _parse_one(item: str) -> tuple[str, _Fault]:
@@ -125,11 +167,22 @@ def _parse_one(item: str) -> tuple[str, _Fault]:
         head, _, cnt = act.rpartition("x")
         if cnt.strip().isdigit():
             act, remaining = head.strip(), int(cnt)
+    p = 1.0
+    m = _P_RE.search(act)
+    if m is not None:
+        try:
+            p = float(m.group(1))
+        except ValueError:
+            raise ValueError(f"bad fault probability in {item!r}")
+        if not 0.0 < p <= 1.0:
+            raise ValueError(f"fault probability {p} not in (0, 1] "
+                             f"in {item!r}")
+        act = act[: m.start()].strip()
     action, _, arg = act.partition(":")
     action = action.strip()
     if action not in ("hang", "stall", "fail", "lost", "exit", "overrun"):
         raise ValueError(f"unknown fault action {action!r} in {item!r}")
-    return point, _Fault(action, arg.strip(), remaining)
+    return point, _Fault(action, arg.strip(), remaining, p, key=point)
 
 
 def configure(spec: str | None) -> None:
@@ -145,10 +198,20 @@ def configure(spec: str | None) -> None:
             _armed[point] = f
 
 
-def arm(point: str, action: str, arg: str = "", count: int = -1) -> None:
+def arm(point: str, action: str, arg: str = "", count: int = -1,
+        p: float = 1.0) -> None:
     """API-side arming (tests that do not want to mutate the env)."""
     with _lock:
-        _armed[point] = _Fault(action, arg, count)
+        _armed[point] = _Fault(action, arg, count, p, key=point)
+
+
+def disarm(point: str) -> None:
+    """Remove ONE armed fault (the counterpart of `arm`).  Callers that
+    arm a fault for their own scope must disarm exactly that key —
+    `disarm_all` would also wipe env-armed faults aimed at later
+    stages of the same process."""
+    with _lock:
+        _armed.pop(point, None)
 
 
 def disarm_all() -> None:
@@ -164,6 +227,10 @@ def _take(point: str, qual: str | None) -> tuple[str, _Fault] | None:
             f = _armed.get(key)
             if f is None or f.remaining == 0:
                 continue
+            if not f.draw():
+                # probabilistic skip: no budget consumed, and the most
+                # specific match decides alone (no fall-through)
+                return None
             if f.remaining > 0:
                 f.remaining -= 1
             return key, f
@@ -195,8 +262,9 @@ def active() -> dict[str, str]:
     """The armed table, for provenance records ({point: "action:arg"})."""
     with _lock:
         return {
-            k: f"{f.action}:{f.arg}" + (f" x{f.remaining}"
-                                        if f.remaining >= 0 else "")
+            k: f"{f.action}:{f.arg}"
+            + (f"@p{f.p:g}" if f.p < 1.0 else "")
+            + (f" x{f.remaining}" if f.remaining >= 0 else "")
             for k, f in _armed.items()
         }
 

@@ -1,3 +1,4 @@
 from ceph_tpu.sim.failure import ClusterSim, MovementReport
+from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
 
-__all__ = ["ClusterSim", "MovementReport"]
+__all__ = ["ClusterSim", "LifetimeSim", "MovementReport", "Scenario"]

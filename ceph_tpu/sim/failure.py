@@ -47,6 +47,12 @@ class MovementReport:
     replicas_moved: int = 0  # osds that entered a pg's up set
     degraded_pgs: int = 0  # up set smaller than pool size
     moved_fraction: float = 0.0
+    # EC-aware risk accounting (sim/lifetime.py): PGs whose up set has
+    # lost more chunks than the pool tolerates (EC: > m, replicated:
+    # > size-1), and the integral of that count over simulated time
+    # under the recovery-rate model
+    pgs_at_risk: int = 0
+    at_risk_pg_seconds: float = 0.0
 
     def merge(self, other: "MovementReport") -> None:
         self.total_pgs += other.total_pgs
@@ -54,6 +60,8 @@ class MovementReport:
         self.pgs_primary_changed += other.pgs_primary_changed
         self.replicas_moved += other.replicas_moved
         self.degraded_pgs += other.degraded_pgs
+        self.pgs_at_risk += other.pgs_at_risk
+        self.at_risk_pg_seconds += other.at_risk_pg_seconds
         if self.total_pgs:
             self.moved_fraction = self.pgs_remapped / self.total_pgs
 
@@ -251,8 +259,17 @@ class ClusterSim:
         p_fail: float = 0.5,
     ) -> list[MovementReport]:
         """OSDThrasher pattern: random kill/revive rounds; every PG must
-        stay mapped (no PG falls off the cluster while >= size OSDs up)."""
+        stay mapped (no PG falls off the cluster while >= size OSDs up).
+
+        The up-OSD floor derives from the LARGEST pool's size: an EC
+        pool of k+m chunks needs k+m distinct up OSDs to stay mappable,
+        so the thrasher never kills below that (the old hardcoded `> 3`
+        floor silently over-thrashed any pool wider than replicated
+        size-3)."""
         rng = rng or np.random.default_rng(0)
+        floor = max(
+            (p.size for p in self.m.pools.values()), default=3
+        )
         downed: list[int] = []
         reports = []
         for _ in range(rounds):
@@ -261,11 +278,11 @@ class ClusterSim:
                 if self.m.is_up(o)
             ]
             if downed and (
-                rng.random() > p_fail or len(up_osds) <= 3
+                rng.random() > p_fail or len(up_osds) <= floor
             ):
                 osd = downed.pop(int(rng.integers(len(downed))))
                 reports.append(self.revive_osd(osd))
-            elif len(up_osds) > 3:
+            elif len(up_osds) > floor:
                 osd = int(up_osds[int(rng.integers(len(up_osds)))])
                 downed.append(osd)
                 reports.append(self.fail_osd(osd))

@@ -1,0 +1,1325 @@
+"""Cluster-lifetime chaos simulator: thousands of epochs of failure,
+churn, and growth under deterministic fault schedules.
+
+Every other workload in the repo maps a *static* cluster (bench configs)
+or runs single-shot thrash rounds (`sim.failure.ClusterSim`).  This
+module composes every subsystem into one long-running torture test:
+
+- **Events are real epoch deltas.**  Each simulated epoch builds an
+  `osd.incremental.Incremental` (OSD flaps/deaths/permanent removals,
+  CRUSH-tree-aware host/rack outages, reweights, pg_temp overrides, pool
+  creation, `pg_num` splits, cluster expansion via the CRUSH builder
+  API) and advances the map through `apply_incremental` — the same
+  epoch-monotonic chain a monitor would publish.  Every
+  `balance_every` epochs the mgr balancer (`ceph_tpu.mgr.Balancer`,
+  upmap mode) runs and `execute()`s its plan, so its Incrementals ride
+  the same chain.
+
+- **Deterministic chaos.**  The event at epoch `e` is drawn from
+  `numpy.random.default_rng([seed, e])` — no RNG state spans epochs, so
+  the same seed produces a bit-identical event trajectory AND a resumed
+  run continues exactly where the interrupted one left off.  The running
+  `digest` (a SHA-256 chain over per-epoch event + accounting lines) is
+  the equality witness: same seed ⇒ same digest, resume ⇒ same final
+  digest.
+
+- **Accounting stays device-side.**  Per-epoch degraded / unmapped /
+  at-risk / moved / remapped tallies reduce ON DEVICE
+  (`core/reduce.py`); only a handful of int64 scalars are fetched per
+  pool per epoch.  Compiled pipelines come from `_PIPE_CACHE`
+  (trace-once): a steady epoch — values changed, structure unchanged —
+  must book **0 compiles**, proven by the `pipe_cache_*` / JitAccount
+  counters and recorded per run in the `trace_once` summary.  Epochs
+  that genuinely change structure (expansion, removal, splits crossing
+  a block-shape boundary, the first balancer pass over a new overlay
+  layout) are classified `structural` and excluded from that gate.
+
+- **EC-aware data-at-risk windows.**  A PG is *at risk* when its up set
+  has lost more chunks than the pool tolerates (EC profile: > m chunks;
+  replicated: > size-1 replicas).  Each epoch's simulated duration
+  follows a configurable recovery-rate model (`moved bytes /
+  recovery_mbps`, floored at `interval_s`), and `at_risk_pg_seconds`
+  integrates the at-risk PG count over that simulated time — the
+  recovery-traffic/data-at-risk framing of "Understanding System
+  Characteristics of Online Erasure Coding on Scalable, Distributed and
+  Large-Scale SSD Array Systems" (PAPERS.md).
+
+- **Robustness is the headline.**  Device loss mid-lifetime
+  (`runtime.faults` point `epoch_apply`, or a real transport loss)
+  degrades that epoch's accounting to the bit-exact host mapper — the
+  digest is unchanged by construction — records provenance, and the
+  simulation continues.  An every-epoch invariant checker (no PG
+  silently unmapped — empty device row while the host oracle maps it,
+  no duplicate OSDs in a row, upmap / pg_temp respected, periodic
+  jax==host spot-check lanes) feeds the `sim` perf group.  Crash safety rides `runtime.Checkpoint`: the full
+  state (map blob + digest + transient-event bookkeeping) flushes
+  atomically every `checkpoint_every` epochs, and `resume=True`
+  continues from the last checkpointed epoch (`lifetime_step=exit:N`
+  fault + `cli/sim.py --resume` is the kill test).
+
+Scenario syntax (`Scenario.parse`): comma-separated `key=value` pairs
+over the `Scenario` dataclass fields, e.g.
+
+    epochs=500,seed=7,hosts=8,osds_per_host=4,racks=2,ec=4+2,
+    balance_every=16,p_flap=0.3,recovery_mbps=250
+
+Headline metric: simulated cluster-years per wallclock hour
+(`cluster_years_per_hour` in the run summary and the `lifetime` bench
+stage).
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import hashlib
+import time
+from dataclasses import dataclass, fields
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.incremental import Incremental, apply_incremental
+from ceph_tpu.osd.osdmap import IN_WEIGHT, OSD_EXISTS, OSD_UP, OSDMap
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+from ceph_tpu.runtime import Checkpoint, faults
+from ceph_tpu.sim.failure import (
+    MovementReport,
+    _device_loss_counter,
+    _map_ref,
+)
+from ceph_tpu.utils import knobs
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("sim")
+
+_L = obs.logger_for("sim")
+_L.add_u64("epochs", "lifetime epochs applied (one Incremental chain "
+                     "link each, plus the balancer's own links)")
+_L.add_u64("events_applied", "non-quiet chaos events applied")
+_L.add_u64("invariant_violations",
+           "per-epoch invariant checks that failed (device-empty rows "
+           "the host oracle maps, duplicate OSDs in a row, "
+           "upmap/pg_temp not respected, jax==host spot-check "
+           "mismatches)")
+_L.add_u64("degraded_pg_epochs", "epochs that ended with >=1 degraded PG")
+_L.add_u64("structural_epochs",
+           "epochs whose event changed compiled structure (expansion, "
+           "removal, block-shape-crossing splits, new overlay layouts) "
+           "— the only epochs allowed to book compiles")
+_L.add_u64("spot_checks", "jax==host spot-check lanes compared")
+_L.add_u64("spotcheck_mismatches", "spot-check lanes that disagreed")
+_L.add_u64("checkpoints", "lifetime checkpoints flushed")
+_L.add_avg("at_risk_pg_seconds",
+           "integral of the at-risk PG count over simulated seconds "
+           "(one observation per epoch)")
+_L.add_quantile("epoch_seconds",
+                "wall-clock seconds per lifetime epoch (apply + remap + "
+                "accounting + invariants)")
+
+
+# --------------------------------------------------------------- scenario
+
+
+@dataclass
+class Scenario:
+    """One lifetime run's shape: cluster, chaos mix, recovery model.
+
+    Parsed from comma-separated `key=value` pairs (`Scenario.parse`);
+    `spec()` renders the canonical string a checkpoint pins so a resume
+    cannot silently continue a *different* scenario."""
+
+    epochs: int = 500
+    seed: int = 0
+    # initial cluster
+    hosts: int = 8
+    osds_per_host: int = 4
+    racks: int = 2
+    pgs: int = 256           # replicated pool pg_num
+    size: int = 3            # replicated pool size
+    ec: str = "4+2"          # EC pool "k+m" ("" disables it)
+    ec_pgs: int = 128
+    chunk: int = 4096        # PG-axis block size of the accounting pass
+    # mgr balancer cadence (0 disables)
+    balance_every: int = 16
+    balance_max: int = 8     # upmap_max_optimizations per run
+    # chaos probabilities per epoch (remaining mass = quiet epoch)
+    p_flap: float = 0.25
+    p_death: float = 0.04
+    p_remove: float = 0.02
+    p_host_outage: float = 0.04
+    p_rack_outage: float = 0.01
+    p_reweight: float = 0.10
+    p_pg_temp: float = 0.04
+    p_pool_create: float = 0.01
+    p_split: float = 0.01
+    p_expand: float = 0.01
+    # transient-event durations (epochs, drawn uniform in [1, len])
+    flap_len: int = 4
+    outage_len: int = 6
+    temp_len: int = 5
+    # recovery-rate model
+    pg_gb: float = 1.0       # data per PG (GB), spread over `size` shards
+    recovery_mbps: float = 100.0
+    interval_s: float = 30.0  # floor of one epoch's simulated duration
+    # growth limits
+    new_pool_pgs: int = 64
+    max_pools: int = 6
+    max_pgs: int = 4096      # per-pool pg_num cap for splits
+    max_expand: int = 8      # hosts added over the whole lifetime
+    # cadences (0 disables); -1 = take the CEPH_TPU_SIM_* env knob
+    checkpoint_every: int = -1
+    spotcheck_every: int = -1
+    spotcheck_lanes: int = 4
+
+    def __post_init__(self):
+        if self.checkpoint_every < 0:
+            self.checkpoint_every = int(
+                knobs.get("CEPH_TPU_SIM_CHECKPOINT_EVERY", "100"))
+        if self.spotcheck_every < 0:
+            self.spotcheck_every = int(
+                knobs.get("CEPH_TPU_SIM_SPOTCHECK", "16"))
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "Scenario":
+        kw: dict = {}
+        types = {f.name: f.type for f in fields(cls)}
+        for item in (spec or "").replace("\n", ",").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, val = item.partition("=")
+            key, val = key.strip(), val.strip()
+            if not sep or key not in types:
+                raise ValueError(f"bad scenario item {item!r} "
+                                 f"(known keys: {sorted(types)})")
+            t = types[key]
+            kw[key] = val if t == "str" else (
+                float(val) if t == "float" else int(val))
+        return cls(**kw)
+
+    def spec(self) -> str:
+        return ",".join(
+            f"{f.name}={getattr(self, f.name)}" for f in fields(self)
+        )
+
+    def ec_km(self) -> tuple[int, int] | None:
+        if not self.ec:
+            return None
+        k, _, mm = self.ec.partition("+")
+        return int(k), int(mm)
+
+    def event_probs(self) -> tuple[tuple[str, float], ...]:
+        """(kind, probability) in a FIXED order — the cumulative walk
+        the per-epoch draw runs over (order is part of determinism)."""
+        return (
+            ("flap", self.p_flap),
+            ("death", self.p_death),
+            ("remove", self.p_remove),
+            ("host_outage", self.p_host_outage),
+            ("rack_outage", self.p_rack_outage),
+            ("reweight", self.p_reweight),
+            ("pg_temp", self.p_pg_temp),
+            ("pool_create", self.p_pool_create),
+            ("split", self.p_split),
+            ("expand", self.p_expand),
+        )
+
+
+def build_cluster(sc: Scenario) -> OSDMap:
+    """The scenario's initial map: hierarchical hosts/racks, one
+    replicated pool, optionally one EC pool with a real erasure rule
+    and profile entry."""
+    from ceph_tpu.osd.osdmap import build_hierarchical
+
+    m = build_hierarchical(
+        sc.hosts, sc.osds_per_host, n_rack=sc.racks,
+        pool=PgPool(
+            type=PoolType.REPLICATED, size=sc.size, crush_rule=0,
+            pg_num=sc.pgs, pgp_num=sc.pgs,
+        ),
+    )
+    km = sc.ec_km()
+    if km is not None:
+        k, mm = km
+        root = next(
+            bid for bid, b in m.crush.buckets.items() if b.type == 11
+        )
+        ruleno = m.crush.make_erasure_rule(
+            root, 1 if sc.hosts > 1 else 0, num_chunks=k + mm
+        )
+        m.erasure_code_profiles["lifetime-ec"] = {
+            "k": str(k), "m": str(mm), "plugin": "jax",
+        }
+        m.add_pool("lifetime-ec", PgPool(
+            type=PoolType.ERASURE, size=k + mm, min_size=k + 1,
+            crush_rule=ruleno, pg_num=sc.ec_pgs, pgp_num=sc.ec_pgs,
+            erasure_code_profile="lifetime-ec",
+        ))
+    return m
+
+
+# --------------------------------------------------- shared stat formulas
+# One formula set, two executors: the jax version runs inside a jitted
+# kernel on device rows; the numpy version is the bit-exact host mirror
+# the degraded (device-lost) path and the "ref" backend use — digest
+# equality across backends depends on these two never diverging.
+
+
+def _stats_np(prev, rows, n: int, size: int, tol: int) -> list[int]:
+    rows = np.asarray(rows)
+    prev = np.asarray(prev)
+    real = np.arange(rows.shape[0]) < n
+    valid = (rows != ITEM_NONE) & (rows >= 0)
+    occ = valid.sum(axis=1)
+    degraded = int((real & (occ < size)).sum())
+    unmapped = int((real & (occ == 0)).sum())
+    at_risk = int((real & (occ < size - tol)).sum())
+    w = rows.shape[1]
+    eq = (rows[:, :, None] == rows[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    dup = int((real & (eq & np.triu(np.ones((w, w), bool), 1)).any(
+        axis=(1, 2))).sum())
+    mem_ab = (rows[:, :, None] == prev[:, None, :]).any(axis=2)
+    moved_l = ~mem_ab & valid
+    moved = int((moved_l & real[:, None]).sum())
+    pvalid = (prev != ITEM_NONE) & (prev >= 0)
+    mem_ba = (prev[:, :, None] == rows[:, None, :]).any(axis=2)
+    changed = moved_l.any(axis=1) | (~mem_ba & pvalid).any(axis=1)
+    remapped = int((real & changed).sum())
+    return [degraded, unmapped, at_risk, dup, moved, remapped]
+
+
+def _build_stats_account():
+    """The jitted device-side epoch reducer (lazy: no jax at module
+    import).  n/size/tol ride as scalar operands so pools sharing row
+    shapes share one executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.core import reduce
+
+    def _epoch_stats(prev, rows, n, size, tol):
+        real = jnp.arange(rows.shape[0]) < n
+        occ = reduce.result_sizes(rows)
+        size = size.astype(jnp.int32)
+        tol = tol.astype(jnp.int32)
+        degraded = jnp.sum((real & (occ < size)).astype(jnp.int64))
+        unmapped = jnp.sum((real & (occ == 0)).astype(jnp.int64))
+        at_risk = jnp.sum((real & (occ < size - tol)).astype(jnp.int64))
+        dup = jnp.sum(
+            (real & reduce.duplicate_rows(rows)).astype(jnp.int64))
+        moved = reduce.misplaced_lanes(prev, rows,
+                                       extra_mask=real[:, None])
+        remapped = jnp.sum(
+            (real & reduce.changed_rows(prev, rows)).astype(jnp.int64))
+        return jnp.stack(
+            [degraded, unmapped, at_risk, dup, moved, remapped])
+
+    return obs.JitAccount(jax.jit(_epoch_stats), _L, "epoch_stats")
+
+
+_STATS_ACCT = None
+
+
+def _stats_account():
+    global _STATS_ACCT
+    if _STATS_ACCT is None:
+        _STATS_ACCT = _build_stats_account()
+    return _STATS_ACCT
+
+
+STAT_KEYS = ("degraded", "unmapped", "at_risk", "dup", "moved",
+             "remapped")
+
+
+# ------------------------------------------------------------- invariants
+
+
+def check_rows_invariants(m: OSDMap, pid: int, rows, n: int,
+                          only_seeds: set[int] | None = None,
+                          oracle=None) -> list[str]:
+    """Host-side invariant check over one pool's up rows [>=n, W]
+    (numpy; lanes beyond n ignored).  Used as the detailed reporter when
+    the device scalars flag a problem, and directly by the
+    negative-control tests.  `only_seeds` restricts every check to that
+    seed subset (the engine's sampled overlay checks, where the other
+    rows were never fetched).  Returns violation strings (empty =
+    clean).
+
+    - no PG silently unmapped: an empty row only violates when the
+      bit-exact host oracle maps the PG somewhere (device/host
+      divergence).  CRUSH itself legitimately returns nothing when its
+      tries exhaust under heavy weight-out, or when every replica is
+      down — the reference calls that a *bad mapping* / a `down` PG
+      (degradation, accounted), never an invariant breach;
+    - no duplicate OSD inside one row;
+    - pg_upmap / pg_upmap_items entries respected by the rows;
+
+    `oracle(seed) -> up list` overrides the host replay source (the
+    engine passes its descent-memoized oracle: a tries-exhausted PG
+    would otherwise re-pay the full descent every flagged epoch).
+    """
+    rows = np.asarray(rows)[:n]
+    seed_iter = sorted(only_seeds) if only_seeds is not None \
+        else range(n)
+    if oracle is None:
+        def oracle(seed):
+            up, _, _, _ = m.pg_to_up_acting_osds(PgId(pid, int(seed)))
+            return up
+    out: list[str] = []
+    valid = (rows != ITEM_NONE) & (rows >= 0)
+    occ = valid.sum(axis=1)
+    empty = [s for s in seed_iter if occ[s] == 0][:8]
+    for seed in empty:  # bounded host replays
+        want = [o for o in oracle(int(seed)) if o != ITEM_NONE]
+        if want:
+            out.append(
+                f"pool {pid} pg {pid}.{int(seed):x} device row empty "
+                f"but the host oracle maps {want}"
+            )
+    # duplicate scan stays vectorized; python only walks the hits
+    w = rows.shape[1]
+    eq = (rows[:, :, None] == rows[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    dup_rows = (eq & np.triu(np.ones((w, w), bool), 1)).any(axis=(1, 2))
+    for seed in seed_iter:
+        if dup_rows[seed]:
+            lanes = [int(o) for o in rows[seed]
+                     if o != ITEM_NONE and o >= 0]
+            out.append(
+                f"pool {pid} pg {pid}.{seed:x} carries duplicate OSDs "
+                f"{lanes}"
+            )
+            if len(out) >= 16:
+                return out
+    for pg, p in m.pg_upmap.items():
+        if pg.pool != pid or pg.seed >= n or (
+                only_seeds is not None and pg.seed not in only_seeds):
+            continue
+        if any(o != ITEM_NONE and 0 <= o < m.max_osd
+               and m.osd_weight[o] == 0 for o in p):
+            continue  # rejected upmap (out target): not applied
+        want = sorted(o for o in p if m.is_up(o))
+        got = sorted(int(o) for o in rows[pg.seed]
+                     if o != ITEM_NONE and o >= 0)
+        if want and got != want:
+            out.append(
+                f"pool {pid} pg {pg} pg_upmap {list(p)} not respected: "
+                f"row {got}"
+            )
+    for pg, pairs in m.pg_upmap_items.items():
+        if pg.pool != pid or pg.seed >= n or (
+                only_seeds is not None and pg.seed not in only_seeds):
+            continue
+        lanes = {int(o) for o in rows[pg.seed]
+                 if o != ITEM_NONE and o >= 0}
+        for frm, to in pairs:
+            if (0 <= to < m.max_osd and m.is_up(to) and m.is_in(to)
+                    and frm in lanes and to not in lanes):
+                out.append(
+                    f"pool {pid} pg {pg} upmap item {frm}->{to} not "
+                    f"respected: {frm} still mapped, {to} absent"
+                )
+    return out
+
+
+def check_pg_temp_invariants(m: OSDMap) -> list[str]:
+    """Model-level pg_temp check: every live pg_temp entry must drive
+    the acting set the reference semantics prescribe (entries filtered
+    of dead OSDs, primary_temp honored)."""
+    out: list[str] = []
+    for pg, temp in m.pg_temp.items():
+        pool = m.pools.get(pg.pool)
+        if pool is None or pg.seed >= pool.pg_num:
+            continue
+        expect = [o for o in temp if m.exists(o) and not m.is_down(o)] \
+            if pool.can_shift_osds() else [
+                o if (m.exists(o) and not m.is_down(o)) else ITEM_NONE
+                for o in temp]
+        if not [o for o in expect if o != ITEM_NONE]:
+            continue  # fully-dead temp: acting falls back to up
+        _, _, acting, actp = m.pg_to_up_acting_osds(pg)
+        if list(acting) != list(expect):
+            out.append(
+                f"pg_temp {pg} {list(temp)} not respected: acting "
+                f"{list(acting)} != {list(expect)}"
+            )
+        want_p = m.primary_temp.get(pg)
+        if want_p is not None and actp != want_p:
+            out.append(
+                f"primary_temp {pg} {want_p} not respected: acting "
+                f"primary {actp}"
+            )
+    return out
+
+
+# ------------------------------------------------------------- the engine
+
+
+class LifetimeSim:
+    """Scenario-driven lifetime engine (see module docstring).
+
+    backend: "jax" (device accounting, host-degradable) or "ref" (host
+    mapper + numpy accounting end to end — bit-identical digests).
+    checkpoint: path of the atomic state file (runtime.Checkpoint
+    shape); resume=True restores from it and continues."""
+
+    def __init__(self, scenario: Scenario | str | None = None,
+                 backend: str = "jax",
+                 checkpoint: str | None = None, resume: bool = False):
+        if isinstance(scenario, str) or scenario is None:
+            scenario = Scenario.parse(scenario)
+        self.scenario = scenario
+        self.backend = backend
+        self.steps = 0
+        self.digest = hashlib.sha256(
+            scenario.spec().encode()).hexdigest()
+        self.sim_seconds = 0.0
+        self.report = MovementReport()
+        self.violations: list[str] = []
+        self.fallback_events: list[str] = []
+        self.event_counts: dict[str, int] = {}
+        self.degraded_epochs = 0
+        self.structural_epochs = 0
+        self.steady_epochs = 0
+        self.steady_compiles = 0
+        self.steady_pipe_misses = 0
+        self.total_compiles = 0
+        # transient-event bookkeeping (all JSON-serializable)
+        self.flap_down: dict[int, int] = {}     # osd -> revive step
+        self.outages: list[list] = []           # [revive step, [osds]]
+        self.temps: list[list] = []             # [pool, seed, clear step]
+        self.dead: list[int] = []
+        self.host_seq = scenario.hosts
+        self.expanded = 0
+        self.resumed_from: int | None = None
+        # in-process caches (never checkpointed: cache state, not truth)
+        self._pm_cache: dict[int, object] = {}
+        self._raw_memo: dict[tuple, tuple] = {}
+        self._prev_rows: dict[int, object] = {}
+        self._prev_skeys: frozenset | None = None
+        self._last_balance_key = None
+        self._loop_warm: set = set()
+        self._steps_this_proc = 0
+        self._wall_this_proc = 0.0
+        self._sim_this_proc = 0.0
+        # test hook: host-path row corruption for invariant negative
+        # controls (fn(pid, rows_np) -> rows_np); None in production
+        self.corrupt_hook = None
+
+        self.ck = Checkpoint(checkpoint, resume=resume) \
+            if checkpoint else None
+        state = (self.ck.data.get("lifetime")
+                 if (self.ck is not None and resume) else None)
+        if state:
+            self._restore(state)
+        else:
+            self.m = build_cluster(scenario)
+        # warm baseline: map every pool once so epoch 1 has prev rows
+        # and the steady-compile gate starts from a compiled structure
+        self._baseline()
+
+    # -- checkpoint/resume -------------------------------------------------
+
+    def _state(self) -> dict:
+        from ceph_tpu.osd.codec import encode_osdmap
+
+        return {
+            "scenario": self.scenario.spec(),
+            "backend": self.backend,
+            "steps": self.steps,
+            "digest": self.digest,
+            "sim_seconds": self.sim_seconds,
+            "report": vars(self.report),
+            "violations": self.violations,
+            "fallback_events": self.fallback_events,
+            "event_counts": self.event_counts,
+            "degraded_epochs": self.degraded_epochs,
+            "structural_epochs": self.structural_epochs,
+            "steady_epochs": self.steady_epochs,
+            "steady_compiles": self.steady_compiles,
+            "steady_pipe_misses": self.steady_pipe_misses,
+            "total_compiles": self.total_compiles,
+            "flap_down": {str(k): v for k, v in self.flap_down.items()},
+            "outages": self.outages,
+            "temps": self.temps,
+            "dead": self.dead,
+            "host_seq": self.host_seq,
+            "expanded": self.expanded,
+            "map_b64": base64.b64encode(
+                encode_osdmap(self.m)).decode(),
+        }
+
+    def _restore(self, state: dict) -> None:
+        from ceph_tpu.osd.codec import decode_osdmap
+
+        if state.get("scenario") != self.scenario.spec():
+            raise ValueError(
+                "checkpoint was written by a different scenario:\n"
+                f"  checkpoint: {state.get('scenario')}\n"
+                f"  requested:  {self.scenario.spec()}"
+            )
+        self.m = decode_osdmap(base64.b64decode(state["map_b64"]))
+        self.steps = int(state["steps"])
+        self.digest = state["digest"]
+        self.sim_seconds = float(state["sim_seconds"])
+        self.report = MovementReport(**state["report"])
+        self.violations = list(state["violations"])
+        self.fallback_events = list(state["fallback_events"])
+        self.event_counts = dict(state["event_counts"])
+        self.degraded_epochs = int(state["degraded_epochs"])
+        self.structural_epochs = int(state["structural_epochs"])
+        self.steady_epochs = int(state["steady_epochs"])
+        self.steady_compiles = int(state["steady_compiles"])
+        self.steady_pipe_misses = int(state["steady_pipe_misses"])
+        self.total_compiles = int(state["total_compiles"])
+        self.flap_down = {int(k): int(v)
+                          for k, v in state["flap_down"].items()}
+        self.outages = [list(x) for x in state["outages"]]
+        self.temps = [list(x) for x in state["temps"]]
+        self.dead = list(state["dead"])
+        self.host_seq = int(state["host_seq"])
+        self.expanded = int(state["expanded"])
+        self.resumed_from = self.steps
+        _log(1, f"lifetime resumed at epoch {self.steps} "
+                f"(map epoch {self.m.epoch})")
+
+    def _checkpoint(self) -> None:
+        if self.ck is None:
+            return
+        self.ck.progress("lifetime", self._state())
+        _L.inc("checkpoints")
+        obs.instant("sim.checkpoint", epoch=self.steps)
+
+    # -- mapping + accounting ---------------------------------------------
+
+    def _baseline(self) -> None:
+        """Map every pool once (rows become epoch 0's `prev`), and
+        establish the structure key set the steady-compile gate diffs
+        against.  Compiles booked here are warmup, not epoch cost."""
+        skeys = set()
+        for pid in sorted(self.m.pools):
+            try:
+                _, skey = self._account_pool(pid, baseline=True)
+            except Exception as e:
+                if not faults.looks_like_device_loss(e):
+                    raise
+                self._record_fallback(0, pid, e)
+                _, skey = self._account_pool(pid, baseline=True,
+                                             force_host=True)
+            skeys.add(skey)
+        self._prev_skeys = frozenset(skeys)
+
+    def _pool_tolerance(self, pool: PgPool) -> int:
+        """Chunks/replicas the pool can lose before data is at risk:
+        EC -> m (from the profile), replicated -> size-1."""
+        if pool.is_erasure():
+            prof = self.m.erasure_code_profiles.get(
+                pool.erasure_code_profile, {})
+            try:
+                return int(prof["m"])
+            except (KeyError, ValueError):
+                return max(0, pool.size - 1)
+        return max(0, pool.size - 1)
+
+    def _pool_mapper(self, pid: int):
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        pm = self._pm_cache.get(pid)
+        if pm is None:
+            pm = PoolMapper(self.m, pid, overlays=False,
+                            chunk=self.scenario.chunk)
+            self._pm_cache[pid] = pm
+        else:
+            pm.refresh_dev()
+        return pm
+
+    def _overlay_fixup(self, pid: int, width: int):
+        """overlay_fixup_rows with the CRUSH descent memoized: the
+        post-descent raw mapping of an overlay-carrying PG only changes
+        on raw-changing events (weights/crush/pool — see
+        RAW_CHANGING_EVENTS, which clear `_raw_memo`), while the upmap
+        application and up/down filter are cheap and recomputed every
+        epoch.  Bit-identical to `pipeline_jax.overlay_fixup_rows`
+        (same reference sequence, OSDMap.cc:2667-2715); without the
+        memo a long lifetime pays one full host descent per
+        accumulated balancer upmap entry per pool per epoch."""
+        m = self.m
+        pool = m.pools[pid]
+        n = pool.pg_num
+        seeds = sorted({
+            pg.seed for pg in list(m.pg_upmap) + list(m.pg_upmap_items)
+            if pg.pool == pid and pg.seed < n
+        })
+        rows = np.full((len(seeds), width), ITEM_NONE, np.int32)
+        for i, s in enumerate(seeds):
+            up = self._host_up(pid, s)
+            rows[i, : min(len(up), width)] = up[:width]
+        return np.asarray(seeds, np.int64), rows
+
+    def _host_up(self, pid: int, seed: int) -> list[int]:
+        """One PG's host-exact `up` set with the descent memoized (see
+        `_overlay_fixup`); the overlay application and up/down filter
+        run fresh every call."""
+        m = self.m
+        pool = m.pools[pid]
+        pg = PgId(pid, int(seed))
+        hit = self._raw_memo.get((pid, seed))
+        if hit is None:
+            hit = m._pg_to_raw_osds(pool, pg)
+            self._raw_memo[(pid, seed)] = hit
+        raw, pps = list(hit[0]), hit[1]
+        m._apply_upmap(pool, pg, raw)
+        up = m._raw_to_up_osds(pool, raw)
+        up_primary = m._pick_primary(up)
+        m._apply_primary_affinity(pps, pool, up, up_primary)
+        return up
+
+    def _rows_device(self, pid: int):
+        import jax.numpy as jnp
+
+        from ceph_tpu.crush.mapper_jax import RESCUE_PAD
+
+        pm = self._pool_mapper(pid)
+        n = pm.spec.pg_num
+        DV = int(pm.dev["weight"].shape[0])
+        # precompile the rescue kernel for this structure so a later
+        # steady epoch's first flagged lane cannot book the compile
+        wk = (pm.cache_key, DV)
+        if wk not in self._loop_warm:
+            pm.jitted_loop()(
+                jnp.zeros(RESCUE_PAD, jnp.uint32), pm.dev, {})
+            self._loop_warm.add(wk)
+        rows = pm.map_all_device(self.scenario.chunk)
+        seeds, fix = self._overlay_fixup(pid, int(rows.shape[1]))
+        if len(seeds):
+            rows = rows.at[jnp.asarray(seeds)].set(jnp.asarray(fix))
+        skey = (pm.cache_key, int(rows.shape[0]), int(rows.shape[1]),
+                DV)
+        return rows, n, skey
+
+    def _account_pool(self, pid: int, baseline: bool = False,
+                      force_host: bool = False):
+        """Map one pool and reduce the epoch stats.  Device path unless
+        the backend is "ref" or a device loss degraded this call."""
+        pool = self.m.pools[pid]
+        tol = self._pool_tolerance(pool)
+        if self.backend == "jax" and not force_host:
+            import jax.numpy as jnp
+
+            rows, n, skey = self._rows_device(pid)
+            prev = self._prev_rows.get(pid)
+            if prev is None or tuple(prev.shape) != tuple(rows.shape):
+                prev_dev = rows  # fresh/resized pool: self-compare
+            else:
+                prev_dev = prev if not isinstance(prev, np.ndarray) \
+                    else jnp.asarray(prev)
+            self._prev_rows[pid] = rows  # stays device-resident
+            out = np.asarray(_stats_account()(
+                prev_dev, rows, jnp.uint32(n), jnp.int32(pool.size),
+                jnp.int32(tol),
+            ))
+            if baseline:  # ran for the warmup, not the books
+                return None, skey
+            st = {k: int(v) for k, v in zip(STAT_KEYS, out)}
+        else:
+            up, _, _, _ = _map_ref(self.m, pid)
+            rows = up.astype(np.int32)
+            if self.corrupt_hook is not None:
+                rows = self.corrupt_hook(pid, rows)
+            n = pool.pg_num
+            skey = ("ref", n, int(rows.shape[1]))
+            prev = self._prev_rows.get(pid)
+            prev_np = rows if (
+                prev is None
+                or tuple(np.shape(prev)) != tuple(rows.shape)
+            ) else np.asarray(prev)
+            self._prev_rows[pid] = rows
+            if baseline:
+                return None, skey
+            st = dict(zip(
+                STAT_KEYS, _stats_np(prev_np, rows, n, pool.size, tol)
+            ))
+        st["n"] = n
+        st["size"] = pool.size
+        st["tol"] = tol
+        return st, skey
+
+    def _record_fallback(self, e: int, pid, exc) -> None:
+        _device_loss_counter().inc("device_loss_fallbacks")
+        msg = f"epoch {e} pool {pid}: {exc} -> host mapper"
+        self.fallback_events.append(msg)
+        _log(1, "device lost mid-lifetime; degrading accounting to "
+                f"the bit-exact host mapper ({msg})")
+
+    def _account_epoch(self, e: int):
+        stats: dict[int, dict] = {}
+        skeys = set()
+        for pid in sorted(self.m.pools):
+            try:
+                faults.check("epoch_apply", qual=str(e))
+                st, skey = self._account_pool(pid)
+            except Exception as exc:
+                # real transport losses raise jaxlib shapes, injected
+                # ones DeviceLostError — both degrade, others are bugs
+                if not faults.looks_like_device_loss(exc):
+                    raise
+                self._record_fallback(e, pid, exc)
+                st, skey = self._account_pool(pid, force_host=True)
+            stats[pid] = st
+            skeys.add(skey)
+        # removed pools leave no stale prev rows behind
+        for pid in list(self._prev_rows):
+            if pid not in self.m.pools:
+                del self._prev_rows[pid]
+                self._pm_cache.pop(pid, None)
+        return stats, frozenset(skeys)
+
+    # -- invariants --------------------------------------------------------
+
+    def _row_slice(self, pid: int, seeds: np.ndarray) -> np.ndarray:
+        rows = self._prev_rows[pid]
+        if isinstance(rows, np.ndarray):
+            return rows[seeds]
+        import jax.numpy as jnp
+
+        return np.asarray(rows[jnp.asarray(seeds)])
+
+    def _invariants(self, e: int, rng, stats: dict) -> None:
+        up_osds = sum(
+            1 for o in range(self.m.max_osd) if self.m.is_up(o))
+        for pid, st in stats.items():
+            pool = self.m.pools[pid]
+            flagged = st["dup"] > 0 or (
+                st["unmapped"] > 0 and up_osds >= pool.size)
+            if flagged:
+                rows = self._prev_rows[pid]
+                msgs = check_rows_invariants(
+                    self.m, pid, np.asarray(rows), st["n"],
+                    oracle=lambda s, pid=pid: self._host_up(pid, s))
+                if st["dup"] and not any("duplicate" in v
+                                         for v in msgs):
+                    msgs.append(
+                        f"pool {pid}: device scalars flagged "
+                        f"dup={st['dup']} but the host detail pass "
+                        "found none (device/host divergence)")
+                self._violate(e, msgs)  # may be empty: an empty up
+                # row whose raw replay maps nothing is degradation
+            else:
+                # overlay respect stays cheap: only overlay-carrying
+                # seeds are fetched (bounded sample)
+                self._check_overlays(e, pid, st["n"], rng)
+        temp_msgs = check_pg_temp_invariants(self.m)
+        if temp_msgs:
+            self._violate(e, temp_msgs)
+        every = self.scenario.spotcheck_every
+        if every and e % every == 0:
+            self._spot_check(e, rng)
+
+    def _check_overlays(self, e: int, pid: int, n: int, rng) -> None:
+        seeds = sorted({
+            pg.seed for src in (self.m.pg_upmap, self.m.pg_upmap_items)
+            for pg in src if pg.pool == pid and pg.seed < n
+        })
+        if not seeds:
+            return
+        if len(seeds) > 32:
+            pick = rng.choice(len(seeds), 32, replace=False)
+            seeds = sorted(seeds[i] for i in pick)
+        idx = np.asarray(seeds, np.int64)
+        sub = self._row_slice(pid, idx)
+        full = np.full((n, sub.shape[1]), ITEM_NONE, sub.dtype)
+        full[idx] = sub
+        msgs = check_rows_invariants(
+            self.m, pid, full, n, only_seeds=set(seeds),
+            oracle=lambda s, pid=pid: self._host_up(pid, s))
+        if msgs:
+            self._violate(e, msgs)
+
+    def _spot_check(self, e: int, rng) -> None:
+        K = self.scenario.spotcheck_lanes
+        for pid in sorted(self.m.pools):
+            n = self.m.pools[pid].pg_num
+            seeds = np.unique(rng.integers(0, n, size=K))
+            got = self._row_slice(pid, seeds)
+            for seed, row in zip(seeds, got):
+                _L.inc("spot_checks")
+                up, _, _, _ = self.m.pg_to_up_acting_osds(
+                    PgId(pid, int(seed)))
+                want = sorted(o for o in up if o != ITEM_NONE)
+                have = sorted(int(o) for o in row
+                              if o != ITEM_NONE and o >= 0)
+                if want != have:
+                    _L.inc("spotcheck_mismatches")
+                    self._violate(e, [
+                        f"spot-check pool {pid} pg {pid}.{int(seed):x}: "
+                        f"device {have} != host {want}"
+                    ])
+
+    def _violate(self, e: int, msgs: list[str]) -> None:
+        for msg in msgs:
+            _L.inc("invariant_violations")
+            self.violations.append(f"epoch {e}: {msg}")
+            _log(0, f"INVARIANT epoch {e}: {msg}")
+
+    # -- events ------------------------------------------------------------
+
+    def _devices_under(self, bid: int) -> list[int]:
+        out: list[int] = []
+        b = self.m.crush.buckets.get(bid)
+        if b is None:
+            return out
+        for it in b.items:
+            if it >= 0:
+                out.append(it)
+            else:
+                out.extend(self._devices_under(it))
+        return out
+
+    def _buckets_of_type(self, type_: int) -> list[int]:
+        shadows = {
+            sid for per in self.m.crush.class_bucket.values()
+            for sid in per.values()
+        }
+        return sorted(
+            (bid for bid, b in self.m.crush.buckets.items()
+             if b.type == type_ and bid not in shadows),
+            reverse=True,
+        )
+
+    def _floor(self) -> int:
+        return max((p.size for p in self.m.pools.values()), default=3)
+
+    def _ups(self, exclude: set) -> list[int]:
+        return [o for o in range(self.m.max_osd)
+                if self.m.is_up(o) and o not in exclude]
+
+    def _draw_kind(self, rng) -> str:
+        u = float(rng.random())
+        acc = 0.0
+        for kind, p in self.scenario.event_probs():
+            acc += p
+            if u < acc:
+                return kind
+        return "quiet"
+
+    def _apply_event(self, e: int, rng, force: str | None) -> str:
+        m = self.m
+        sc = self.scenario
+        inc = Incremental(epoch=m.epoch + 1)
+        notes: list[str] = []
+        touched: set[int] = set()
+
+        # transient expiries ride the same epoch delta
+        for osd in sorted(o for o, t in self.flap_down.items()
+                          if t <= e):
+            del self.flap_down[osd]
+            if m.exists(osd) and m.is_down(osd):
+                inc.new_state[osd] = OSD_UP
+                touched.add(osd)
+                notes.append(f"revive osd.{osd}")
+        for rec in [r for r in self.outages if r[0] <= e]:
+            self.outages.remove(rec)
+            back = []
+            for osd in rec[1]:
+                if (m.exists(osd) and m.is_down(osd)
+                        and osd not in touched
+                        and osd not in self.flap_down
+                        and osd not in self.dead):
+                    inc.new_state[osd] = OSD_UP
+                    touched.add(osd)
+                    back.append(osd)
+            notes.append(f"outage-end osds={back}")
+        for rec in [r for r in self.temps if r[2] <= e]:
+            self.temps.remove(rec)
+            pg = PgId(int(rec[0]), int(rec[1]))
+            inc.new_pg_temp[pg] = []
+            inc.new_primary_temp[pg] = -1
+            notes.append(f"pg_temp-clear {pg}")
+
+        balance = (sc.balance_every
+                   and e % sc.balance_every == 0 and force is None)
+        kind = "balance" if balance else (force or self._draw_kind(rng))
+        if kind != "balance":
+            kind, detail = self._apply_kind(kind, e, rng, inc, touched)
+            apply_incremental(m, inc)
+        else:
+            if (inc.new_state or inc.new_pg_temp
+                    or inc.new_primary_temp):
+                apply_incremental(m, inc)  # expiries first, own epoch
+            detail = self._balance(e)
+        if kind != "quiet":
+            _L.inc("events_applied")
+        self.event_counts[kind] = self.event_counts.get(kind, 0) + 1
+        if notes:
+            detail = detail + " +" + "+".join(notes)
+        return detail
+
+    def _apply_kind(self, kind: str, e: int, rng, inc: Incremental,
+                    touched: set) -> tuple[str, str]:
+        m, sc = self.m, self.scenario
+        ups = self._ups(touched)
+        floor = self._floor()
+
+        def quiet(why: str) -> tuple[str, str]:
+            return "quiet", f"quiet({why})"
+
+        if kind == "quiet":
+            return "quiet", "quiet"
+
+        if kind == "flap":
+            if len(ups) - 1 < floor or not ups:
+                return quiet("flap:floor")
+            osd = int(ups[int(rng.integers(len(ups)))])
+            inc.new_state[osd] = OSD_UP
+            self.flap_down[osd] = e + 1 + int(
+                rng.integers(1, sc.flap_len + 1))
+            return kind, f"flap osd.{osd}"
+
+        if kind == "death":
+            if len(ups) - 1 < floor or not ups:
+                return quiet("death:floor")
+            osd = int(ups[int(rng.integers(len(ups)))])
+            inc.new_state[osd] = OSD_UP
+            inc.new_weight[osd] = 0
+            self.dead.append(osd)
+            return kind, f"death osd.{osd}"
+
+        if kind == "remove":
+            if not self.dead:
+                return quiet("remove:none-dead")
+            cand = sorted(self.dead)
+            osd = int(cand[int(rng.integers(len(cand)))])
+            self.dead.remove(osd)
+            c2 = copy.deepcopy(m.crush)
+            c2.remove_item(osd)
+            from ceph_tpu.crush.codec import encode_crushmap
+
+            inc.crush = encode_crushmap(c2)
+            inc.new_state[osd] = OSD_EXISTS  # destroy
+            return kind, f"remove osd.{osd}"
+
+        if kind in ("host_outage", "rack_outage"):
+            type_ = 1 if kind == "host_outage" else 3
+            buckets = self._buckets_of_type(type_)
+            if not buckets:
+                return quiet(f"{kind}:no-bucket")
+            bid = int(buckets[int(rng.integers(len(buckets)))])
+            victims = [o for o in self._devices_under(bid)
+                       if m.is_up(o) and o not in touched]
+            if not victims or len(ups) - len(victims) < floor:
+                return quiet(f"{kind}:floor")
+            for osd in victims:
+                inc.new_state[osd] = OSD_UP
+            self.outages.append([
+                e + 1 + int(rng.integers(1, sc.outage_len + 1)),
+                victims,
+            ])
+            name = m.crush.item_names.get(bid, str(bid))
+            return kind, f"{kind} {name} osds={victims}"
+
+        if kind == "reweight":
+            cand = [o for o in ups if m.is_in(o)]
+            if not cand:
+                return quiet("reweight:none")
+            osd = int(cand[int(rng.integers(len(cand)))])
+            w = int(round((0.6 + 0.4 * float(rng.random())) * IN_WEIGHT))
+            inc.new_weight[osd] = w
+            return kind, f"reweight osd.{osd} {w}"
+
+        if kind == "pg_temp":
+            pids = sorted(m.pools)
+            pid = int(pids[int(rng.integers(len(pids)))])
+            pool = m.pools[pid]
+            seed = int(rng.integers(pool.pg_num))
+            pg = PgId(pid, seed)
+            if any(r[0] == pid and r[1] == seed for r in self.temps):
+                return quiet("pg_temp:exists")
+            up, _, _, _ = m.pg_to_up_acting_osds(pg)
+            members = [o for o in up if o != ITEM_NONE]
+            if len(members) < 2:
+                return quiet("pg_temp:thin")
+            temp = members[1:] + members[:1]  # rotated acting override
+            inc.new_pg_temp[pg] = temp
+            inc.new_primary_temp[pg] = temp[0]
+            self.temps.append([
+                pid, seed,
+                e + 1 + int(rng.integers(1, sc.temp_len + 1)),
+            ])
+            return kind, f"pg_temp {pg} {temp}"
+
+        if kind == "pool_create":
+            if len(m.pools) >= sc.max_pools:
+                return quiet("pool_create:cap")
+            pid = m.pool_max + 1
+            inc.new_pool_max = pid
+            inc.new_pools[pid] = PgPool(
+                type=PoolType.REPLICATED, size=sc.size, crush_rule=0,
+                pg_num=sc.new_pool_pgs, pgp_num=sc.new_pool_pgs,
+            )
+            inc.new_pool_names[pid] = f"pool{pid}"
+            return kind, f"pool_create pool{pid} pgs={sc.new_pool_pgs}"
+
+        if kind == "split":
+            cand = sorted(
+                pid for pid, p in m.pools.items()
+                if p.pg_num * 2 <= sc.max_pgs
+            )
+            if not cand:
+                return quiet("split:cap")
+            pid = int(cand[int(rng.integers(len(cand)))])
+            pool = inc.get_new_pool(pid, m.pools[pid])
+            pool.pg_num *= 2
+            pool.pgp_num = pool.pg_num
+            return kind, f"split pool{pid} pg_num={pool.pg_num}"
+
+        if kind == "expand":
+            if self.expanded >= sc.max_expand:
+                return quiet("expand:cap")
+            H = self.host_seq
+            first = m.max_osd
+            new = list(range(first, first + sc.osds_per_host))
+            c2 = copy.deepcopy(m.crush)
+            loc = {"host": f"host{H}", "root": "default"}
+            if sc.racks:
+                loc["rack"] = f"rack{int(rng.integers(sc.racks))}"
+            for o in new:
+                c2.insert_item(o, 1.0, f"osd.{o}", loc)
+            from ceph_tpu.crush.codec import encode_crushmap
+
+            inc.crush = encode_crushmap(c2)
+            inc.new_max_osd = first + sc.osds_per_host
+            for o in new:
+                inc.new_up_client[o] = b""
+                inc.new_weight[o] = IN_WEIGHT
+            self.host_seq += 1
+            self.expanded += 1
+            return kind, (f"expand host{H} osds={new} "
+                          f"rack={loc.get('rack', '-')}")
+
+        raise ValueError(f"unknown event kind {kind!r}")
+
+    def _balance(self, e: int) -> str:
+        from ceph_tpu.mgr import Balancer, MappingState, \
+            synthetic_pg_stats
+
+        mapper = "jax" if self.backend == "jax" else "ref"
+        try:
+            bal = Balancer(
+                options={"upmap_max_optimizations":
+                         self.scenario.balance_max},
+                rng=np.random.default_rng(
+                    [self.scenario.seed, e, 1]),
+            )
+            ms = MappingState(self.m, synthetic_pg_stats(self.m),
+                              desc=f"epoch{e}", mapper=mapper)
+            plan = bal.plan_create(f"epoch{e}", ms, mode="upmap")
+            rc, _ = bal.optimize(plan)
+            if rc == 0:
+                rc2, msg = bal.execute(plan, self.m)
+                if rc2 != 0:
+                    raise RuntimeError(f"balancer execute: {msg}")
+                changed = (len(plan.inc.new_pg_upmap_items)
+                           + len(plan.inc.old_pg_upmap_items))
+                return f"balance changed={changed}"
+        except Exception as exc:
+            # same contract as _account_epoch: REAL transport losses
+            # raise jaxlib shapes, injected ones DeviceLostError — both
+            # degrade (skip this round, sim continues); anything else
+            # is a bug and aborts
+            if not faults.looks_like_device_loss(exc):
+                raise
+            self._record_fallback(e, "balancer", exc)
+        apply_incremental(self.m, Incremental(epoch=self.m.epoch + 1))
+        return "balance changed=0"
+
+    # -- the step ----------------------------------------------------------
+
+    def _overlay_presence(self) -> tuple:
+        m = self.m
+        return tuple(sorted(
+            (pid,
+             any(pg.pool == pid for pg in m.pg_upmap),
+             any(pg.pool == pid for pg in m.pg_upmap_items),
+             any(pg.pool == pid for pg in m.pg_temp))
+            for pid in m.pools
+        ))
+
+    def step(self, force_event: str | None = None) -> dict:
+        e = self.steps + 1
+        faults.check("lifetime_step", qual=str(e))
+        rng = np.random.default_rng([self.scenario.seed, e])
+        t0 = time.perf_counter()
+        jit0 = obs.jit_counters()
+        with obs.span("sim.epoch", epoch=e):
+            event = self._apply_event(e, rng, force_event)
+            if event.startswith("balance"):
+                bal_key = (self._prev_skeys, self._overlay_presence())
+                structural_hint = bal_key != self._last_balance_key
+                self._last_balance_key = bal_key
+            else:
+                structural_hint = False
+            if not inc_crush_kept(event):
+                self._pm_cache.clear()
+            if event.split(" ", 1)[0] in RAW_CHANGING_EVENTS:
+                self._raw_memo.clear()
+            stats, skeys = self._account_epoch(e)
+            epoch_s = self._integrate(stats)
+            self._invariants(e, rng, stats)
+        jd = obs.jit_counters_delta(jit0)
+        compiles = jd["compiles"] + jd["retraces"]
+        structural = (structural_hint
+                      or self._prev_skeys is None
+                      or skeys != self._prev_skeys)
+        self._prev_skeys = skeys
+        self.total_compiles += compiles
+        if structural:
+            self.structural_epochs += 1
+            _L.inc("structural_epochs")
+        else:
+            self.steady_epochs += 1
+            self.steady_compiles += compiles
+            self.steady_pipe_misses += jd["pipe_cache_misses"]
+            if compiles:
+                _log(1, f"epoch {e}: steady epoch booked {compiles} "
+                        f"compile(s) — trace-once contract broken "
+                        f"({event})")
+        line = (
+            f"{e}|{event}|"
+            + ";".join(
+                "{}:{}".format(pid, ":".join(
+                    str(stats[pid][k]) for k in ("n",) + STAT_KEYS))
+                for pid in sorted(stats))
+            + f"|{epoch_s:.6f}"
+        )
+        self.digest = hashlib.sha256(
+            (self.digest + line).encode()).hexdigest()
+        self.steps = e
+        self._steps_this_proc += 1
+        _L.inc("epochs")
+        wall = time.perf_counter() - t0
+        self._wall_this_proc += wall
+        _L.observe("epoch_seconds", wall)
+        every = self.scenario.checkpoint_every
+        if self.ck is not None and every and e % every == 0:
+            self._checkpoint()
+        return {
+            "epoch": e,
+            "event": event,
+            "stats": {pid: dict(st) for pid, st in stats.items()},
+            "sim_epoch_s": epoch_s,
+            "structural": structural,
+            "compiles": compiles,
+        }
+
+    def _integrate(self, stats: dict) -> float:
+        sc = self.scenario
+        moved_bytes = 0.0
+        totals = {k: 0 for k in STAT_KEYS}
+        total_pgs = 0
+        for st in stats.values():
+            for k in STAT_KEYS:
+                totals[k] += st[k]
+            total_pgs += st["n"]
+            moved_bytes += st["moved"] * (sc.pg_gb / st["size"]) * 1e9
+        epoch_s = max(sc.interval_s,
+                      moved_bytes / (sc.recovery_mbps * 1e6))
+        self.sim_seconds += epoch_s
+        self._sim_this_proc += epoch_s
+        rep = MovementReport(
+            total_pgs=total_pgs,
+            pgs_remapped=totals["remapped"],
+            replicas_moved=totals["moved"],
+            degraded_pgs=totals["degraded"],
+            pgs_at_risk=totals["at_risk"],
+            at_risk_pg_seconds=totals["at_risk"] * epoch_s,
+        )
+        self.report.merge(rep)
+        _L.observe("at_risk_pg_seconds", rep.at_risk_pg_seconds)
+        if totals["degraded"]:
+            self.degraded_epochs += 1
+            _L.inc("degraded_pg_epochs")
+        return epoch_s
+
+    # -- driving -----------------------------------------------------------
+
+    def run(self, stop_after: int | None = None,
+            epochs: int | None = None) -> dict:
+        total = self.scenario.epochs if epochs is None else epochs
+        while self.steps < total:
+            if stop_after is not None and self.steps >= stop_after:
+                break
+            self.step()
+        self._checkpoint()
+        return self.summary()
+
+    def provenance(self) -> dict:
+        return {
+            "backend": self.backend,
+            "device_loss_fallbacks": len(self.fallback_events),
+            "fallback_events": list(self.fallback_events),
+        }
+
+    def summary(self) -> dict:
+        wall = self._wall_this_proc
+        steps = self._steps_this_proc
+        sim_years = self.sim_seconds / (86400.0 * 365.0)
+        out = {
+            "scenario": self.scenario.spec(),
+            "epochs": self.steps,
+            "map_epoch": self.m.epoch,
+            "digest": self.digest,
+            "sim_seconds": round(self.sim_seconds, 3),
+            "sim_years": round(sim_years, 6),
+            "events": dict(sorted(self.event_counts.items())),
+            "invariant_violations": len(self.violations),
+            "violations": self.violations[:20],
+            "degraded_epochs": self.degraded_epochs,
+            "report": vars(self.report),
+            "trace_once": {
+                "structural_epochs": self.structural_epochs,
+                "steady_epochs": self.steady_epochs,
+                "steady_compiles": self.steady_compiles,
+                "steady_pipe_misses": self.steady_pipe_misses,
+                "total_compiles": self.total_compiles,
+            },
+            "jit_compiles_per_epoch": round(
+                self.total_compiles / self.steps, 4
+            ) if self.steps else 0.0,
+            "provenance": self.provenance(),
+            "wall_s": round(wall, 3),
+            "epochs_per_sec": round(steps / wall, 2) if wall else 0.0,
+            # simulated years covered by THIS process's epochs per
+            # wallclock hour — the headline rate (a resumed run reports
+            # its own portion, not the checkpointed history's)
+            "cluster_years_per_hour": round(
+                (self._sim_this_proc / (86400.0 * 365.0))
+                / (wall / 3600.0), 3
+            ) if wall else 0.0,
+        }
+        if self.resumed_from is not None:
+            out["resumed_from"] = self.resumed_from
+        return out
+
+
+# events after which a PG's post-descent raw mapping may differ: the
+# CRUSH tree changed (remove/expand), the descent's weight overlay
+# changed (death zeroes, reweight scales), or the pool table changed
+# (split/pool_create).  Everything else — flaps, outages, pg_temp,
+# balancer upmap entries — only changes the up/down filter or the
+# post-descent overlay application, both recomputed per epoch, so
+# `_raw_memo` survives (staleness would be caught by the spot-check
+# lanes and the overlay-respect invariant).
+RAW_CHANGING_EVENTS = frozenset(
+    ("death", "reweight", "remove", "expand", "split", "pool_create"))
+
+
+def inc_crush_kept(event: str) -> bool:
+    """True when the event left the CRUSH tree and pool table intact —
+    the compiled PoolMapper cache stays valid.  Events that ship a new
+    crush blob (remove/expand) or mutate pool structure (split /
+    pool_create) must rebuild mappers."""
+    head = event.split(" ", 1)[0]
+    return head not in ("remove", "expand", "split", "pool_create")

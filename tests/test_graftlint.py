@@ -347,9 +347,20 @@ def test_fault_point_clean(tmp_path):
         "faults.check('map_batch')\n"
         "faults.check('init', qual='tpu')\n"
         "SPEC = 'init.auto=hang:600,stage_end.ec_jax=exit:3'\n"
+        "FLAKY = 'epoch_apply=lost:chaos@p0.3x2'\n"  # probabilistic arm
         "NOT_A_SPEC = 'a=b,c=d'\n"             # unknown action: not a spec
     ), "fault-point")
     assert v == []
+
+
+def test_fault_point_probabilistic_spec_undeclared_base(tmp_path):
+    """The `@pP` suffix must not hide an undeclared point from the
+    spec-string scan."""
+    v = lint(tmp_path, (
+        "SPEC = 'bogus_flaky=lost@p0.5x1'\n"
+    ), "fault-point")
+    assert [x.line for x in v] == [1]
+    assert "bogus_flaky" in v[0].message
 
 
 def test_fault_point_flags_untested_declared_point():
@@ -420,6 +431,7 @@ def test_fault_registry_covers_compiled_in_points():
 
     assert set(faults.FAULT_POINTS) == {
         "init", "map_batch", "stage", "stage_end",
+        "epoch_apply", "lifetime_step",
     }
 
 

@@ -61,6 +61,64 @@ class TestFaults:
             faults.configure("init=explode:1")
         with pytest.raises(ValueError):
             faults.configure("just-a-word")
+        with pytest.raises(ValueError):
+            faults.configure("init=fail@p1.5")  # p outside (0, 1]
+        with pytest.raises(ValueError):
+            faults.configure("init=fail@p0")
+
+    def test_probabilistic_arming_deterministic(self):
+        """`@pP` fires per-hit with probability P from an RNG seeded by
+        the spec itself: the fire/skip sequence is identical across
+        re-arms (chaos replay), skips consume no xN budget, and
+        active() renders the probability back."""
+        spec = "map_batch=lost:flaky@p0.3x2"
+
+        def sequence(n: int) -> list[bool]:
+            faults.configure(spec)
+            out = []
+            for _ in range(n):
+                try:
+                    faults.check("map_batch")
+                    out.append(False)
+                except runtime.DeviceLostError:
+                    out.append(True)
+            faults.disarm_all()
+            return out
+
+        a = sequence(40)
+        assert a == sequence(40)     # bit-identical replay
+        assert sum(a) == 2           # xN budget still bounds firings
+        assert 0 < a.index(True)     # and some hits were skipped
+
+        faults.configure(spec)
+        assert faults.active() == {"map_batch": "lost:flaky@p0.3 x2"}
+
+    def test_probabilistic_points_draw_independently(self):
+        """Two points armed with the SAME action/arg/p must not fire in
+        lockstep: the rng seed includes the armed point."""
+        faults.configure("map_batch=lost@p0.5,epoch_apply=lost@p0.5")
+
+        def seq(point, qual=None):
+            out = []
+            for _ in range(30):
+                try:
+                    faults.check(point, qual=qual)
+                    out.append(False)
+                except runtime.DeviceLostError:
+                    out.append(True)
+            return out
+
+        assert seq("map_batch") != seq("epoch_apply")
+
+    def test_probabilistic_skip_no_fallthrough(self):
+        """A probabilistic skip on the specific match must not fall
+        through to a bare always-fire entry."""
+        faults.configure("stage=fail:generic,"
+                         "stage.ec=fail:specific@p0.001x1")
+        for _ in range(20):  # p=0.001: these hits all skip
+            faults.check("stage", qual="ec")
+        with pytest.raises(runtime.FaultInjected, match="generic"):
+            faults.check("stage", qual="other")
 
     def test_disarmed_is_noop(self):
         faults.disarm_all()
@@ -330,14 +388,16 @@ def test_bench_minimal_run_records_provenance(tmp_path):
 @pytest.mark.slow
 def test_bench_selftest():
     """The survivability gate: injected TPU-init hang, every stage
-    (including the miniature rebalance) must complete with degradation
-    provenance.  <60s warm; in the smoke tier and full runs (slow: two
-    jax worker processes' compiles are too heavy for the tier-1 budget —
-    the scheduler/ladder units and the minimal bench run above cover
-    this layer there)."""
+    (including the miniature rebalance and the 510-epoch lifetime chaos
+    scenario) must complete with degradation provenance.  Minutes-scale
+    on a throttled container; in the smoke tier and full runs (slow:
+    the jax worker compiles and the lifetime epochs are far too heavy
+    for the tier-1 budget — the scheduler/ladder units, the minimal
+    bench run above, and tests/test_lifetime.py cover this layer
+    there)."""
     proc = subprocess.run(
         [sys.executable, str(REPO / "bench.py"), "--selftest"],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=700,
         cwd=str(REPO),
         env={k: v for k, v in os.environ.items()
              if k not in ("BENCH_WORKER", "BENCH_REQUIRE_TPU")},

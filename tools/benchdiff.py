@@ -31,7 +31,7 @@ deltas are recorded as informational (`uncalibrated`) and never flagged
 — a slower container is not a regression.  Structural metrics (jit
 compiles, pipe-cache hits, trace_once_ok) compare raw everywhere.
 
-`schema_version`: bench.py stamps the records it writes (current: 2);
+`schema_version`: bench.py stamps the records it writes (current: 4);
 this reader accepts <= SCHEMA_VERSION and marks newer rounds with a
 note instead of guessing at fields it does not know.
 """
@@ -50,7 +50,11 @@ from pathlib import Path
 # v3: adds the placement `diagnostics` section (bad mappings, retry
 #     histogram, default-path non-perturbation proof) and recognizes
 #     MULTICHIP_r*.json trajectory wrappers as their own series.
-SCHEMA_VERSION = 3
+# v4: adds the `lifetime` section (chaos-scenario structural metrics:
+#     invariant violations, steady/jit compiles per epoch, degraded
+#     epochs, resume-digest proof; epochs/s and cluster-years/hour as
+#     hardware-sensitive rates).
+SCHEMA_VERSION = 4
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -105,8 +109,8 @@ def _from_partial(raw: dict) -> dict:
             ec.update({k: v for k, v in st.items() if k != "perf"})
     if ec:
         rec["ec"] = ec
-    for key in ("balancer", "rebalance", "executables", "quantiles",
-                "schema_version"):
+    for key in ("balancer", "rebalance", "lifetime", "executables",
+                "quantiles", "schema_version"):
         if key in raw:
             rec[key] = raw[key]
     init = raw.get("init") or {}
@@ -286,6 +290,31 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         put("diagnostics.tries_max",
             max((i for i, v in enumerate(hist) if v), default=0),
             False, False)
+    # lifetime chaos scenario (v4): the torture-test trajectory.  The
+    # scenario is seeded, so its event/accounting tallies are
+    # bit-determined — invariant violations, compiles-per-epoch,
+    # degraded-epoch counts and the resume proof compare raw (semantic
+    # drift, never hardware variance); only the rates are
+    # hardware-sensitive.
+    lf = rec.get("lifetime") or {}
+    put("lifetime.invariant_violations",
+        lf.get("invariant_violations"), False, False)
+    put("lifetime.steady_compiles", lf.get("steady_compiles"),
+        False, False)
+    put("lifetime.jit_compiles_per_epoch",
+        lf.get("jit_compiles_per_epoch"), False, False)
+    put("lifetime.degraded_epochs", lf.get("degraded_epochs"),
+        False, False)
+    put("lifetime.epochs", lf.get("epochs"), True, False)
+    put("lifetime.at_risk_pg_seconds", lf.get("at_risk_pg_seconds"),
+        False, False)
+    if isinstance(lf.get("resume_digest_match"), bool):
+        out["lifetime.resume_digest_match"] = (
+            float(lf["resume_digest_match"]), True, False)
+    put("lifetime.epochs_per_sec", lf.get("epochs_per_sec"),
+        True, True)
+    put("lifetime.cluster_years_per_hour",
+        lf.get("cluster_years_per_hour"), True, True)
     # multichip trajectory (normalized MULTICHIP_r*.json wrappers)
     mc = rec.get("multichip") or {}
     put("multichip.n_devices", mc.get("n_devices"), True, False)

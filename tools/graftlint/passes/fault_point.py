@@ -27,10 +27,12 @@ from tools.graftlint.engine import (
 
 FAULTS_MODULE = "ceph_tpu/runtime/faults.py"
 
-# one item of a CEPH_TPU_FAULTS spec: point[.qual]=action[:arg][ xN]
+# one item of a CEPH_TPU_FAULTS spec:
+# point[.qual]=action[:arg][@pP][ xN]
 _SPEC_ITEM = re.compile(
     r"^([A-Za-z_]\w*)(\.[\w.-]+)?="
-    r"(hang|stall|fail|lost|exit|overrun)(:[^,\s]*)?(\s*x\d+)?$"
+    r"(hang|stall|fail|lost|exit|overrun)(:[^,\s@]*)?"
+    r"(@p[\d.]+)?(\s*x\d+)?$"
 )
 
 
