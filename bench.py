@@ -77,9 +77,18 @@ BENCH_CHUNK, BENCH_DEADLINE_S, BENCH_REPS, BENCH_REQUIRE_TPU,
 BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
 second mapping config, selftest), BENCH_BAL_PGS/_OSDS/_COMPAT_ITERS
 (balancer stage), BENCH_LIFETIME_SCENARIO/_EPOCHS/_CK (lifetime
-stage), plus the CEPH_TPU_FAULTS / CEPH_TPU_LADDER /
-CEPH_TPU_INIT_* runtime knobs and CEPH_TPU_EC_STRATEGY (forces one
-ec.jax_backend strategy; the ec_jax stage measures all of them anyway).
+stage), BENCH_SERVE_PGS/_OSDS/_SECONDS/_CLIENTS/_BLOCK/_CHAOS_EPOCHS/
+_STALL_BOUND (serve stage), plus the CEPH_TPU_FAULTS /
+CEPH_TPU_LADDER / CEPH_TPU_INIT_* runtime knobs and
+CEPH_TPU_EC_STRATEGY (forces one ec.jax_backend strategy; the ec_jax
+stage measures all of them anyway).
+
+A `serve` stage runs the placement serving daemon (ceph_tpu.serve)
+under seeded client load: sustained QPS + p50/p99 across live epoch
+swaps (swap stall bounded and recorded), an injected mid-traffic
+device loss answered host-side, a deterministic overload burst (EBUSY
+shedding), and a chaos phase where the lifetime engine churns epochs
+against the live service.
 """
 
 from __future__ import annotations
@@ -746,6 +755,181 @@ def bench_clay() -> dict:
     }
 
 
+def bench_serve(h) -> dict:
+    """The `serve` stage: the placement serving daemon under load.
+
+    Phase A (steady): a seeded client load runs against a live
+    `PlacementService` while value-only epoch swaps (reweight
+    Incrementals) land every ~second and one `serve_dispatch` device
+    loss is injected mid-run.  Proves, in the record: sustained QPS
+    with p50/p99, swaps that never stall readers beyond the recorded
+    `swap_stall` bound, 0 compiles in steady state (swaps are operand
+    refreshes through _PIPE_CACHE), the injected loss answered host-side
+    and recovered, and zero dropped queries.
+
+    Phase B (burst): with the dispatcher paused, `max_queue + K`
+    requests overflow admission — exactly K must shed with EBUSY
+    (deterministic), the rest answer after unpause.
+
+    Phase C (chaos): the PR 10 lifetime engine drives epoch churn
+    against the service under client load (serve.chaos.run_chaos) —
+    client-visible p50/p99 under control-plane contention."""
+    import threading
+
+    from ceph_tpu.runtime import faults
+    from ceph_tpu.serve.chaos import _Client, _pct, run_chaos
+    from ceph_tpu.serve.service import PlacementService, ServeConfig
+    from ceph_tpu.osd.incremental import Incremental
+
+    pgs = int(os.environ.get("BENCH_SERVE_PGS", 65536))
+    osds = int(os.environ.get("BENCH_SERVE_OSDS", 256))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 10))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 3))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 2048))
+    chaos_epochs = int(os.environ.get("BENCH_SERVE_CHAOS_EPOCHS", 16))
+    stall_bound_s = float(os.environ.get("BENCH_SERVE_STALL_BOUND",
+                                         0.050))
+    cfg = ServeConfig(block=block, fill=4 * block, max_queue=64,
+                      deadline_s=2.0, degraded_batches=2)
+    m = build_map(pgs, osds)
+    svc = PlacementService(m, config=cfg, name="bench.serve")
+    res: dict = {"pgs": pgs, "osds": osds, "block": block,
+                 "clients": clients, "seconds": seconds}
+    try:
+        jit0 = _jit_counters()  # service staged + warmed above
+
+        # -- phase A: steady load + live swaps + injected device loss --
+        stop = threading.Event()
+        load = [_Client(svc, i, block // 2, stop) for i in range(clients)]
+        loss_at = max(1, int(seconds / 2))
+        rng = np.random.default_rng(3)
+        t0 = time.perf_counter()
+        with obs.span("bench.serve", phase="steady"):
+            for c in load:
+                c.thread.start()
+            swaps = 0
+            stalls_over = 0
+            next_swap = t0 + 1.0
+            lost = False
+            while time.perf_counter() - t0 < seconds:
+                time.sleep(0.05)
+                now = time.perf_counter()
+                if not lost and now - t0 >= loss_at:
+                    # one mid-traffic device loss: the batch it hits
+                    # must answer host-side, then recovery re-walks
+                    faults.arm("serve_dispatch", "lost", "bench", 1)
+                    lost = True
+                if now >= next_swap:
+                    inc = Incremental(epoch=svc.epoch + 1)
+                    for o in rng.choice(osds, 4, replace=False):
+                        inc.new_weight[int(o)] = int(
+                            0x10000 * (0.7 + 0.3 * rng.random()))
+                    r = svc.apply(inc)
+                    if r["ok"]:
+                        swaps += 1
+                        if r["swap_stall_s"] > stall_bound_s:
+                            stalls_over += 1
+                    next_swap = now + 1.0
+            stop.set()
+            for c in load:
+                c.thread.join(timeout=30)
+        faults.disarm("serve_dispatch")
+        wall = time.perf_counter() - t0
+        # drain the degraded spell (small host batches) so recovery —
+        # dispatch re-walking back to the device — is proven in-record
+        # even when the loss landed near the end of the window
+        for _ in range(cfg.degraded_batches + 2):
+            r = svc.lookup_batch(0, np.arange(64), deadline_s=30.0)
+            if r.ok and r.source == "device":
+                break
+        steady_jit = _jit_delta(jit0)
+        lat = [v for c in load for v in c.latencies]
+        submitted = sum(c.submitted for c in load)
+        replied = sum(c.replied for c in load)
+        ok = sum(c.by_status.get("ok", 0) for c in load)
+        st = svc.status()
+        d = obs.perf_dump().get("serve") or {}
+        stall = d.get("swap_stall_seconds") or {}
+        res.update({
+            "qps": round(ok / wall, 1) if wall else 0.0,
+            "answered_ok": ok,
+            "submitted": submitted,
+            "dropped": submitted - replied,
+            "request_p50_s": _pct(lat, 50),
+            "request_p99_s": _pct(lat, 99),
+            "swaps": swaps,
+            "swap_stall_p99_s": stall.get("p99"),
+            "swap_stall_max_s": stall.get("max"),
+            # swaps whose reader-visible stall exceeded the bound: the
+            # structural "never blocks readers" count (0 when healthy)
+            "stall_bound_s": stall_bound_s,
+            "swap_stalls": stalls_over,
+            "steady_shed": st["queries_shed"],
+            "steady_compiles": steady_jit["compiles"]
+            + steady_jit["retraces"],
+            "degraded_answered": st["degraded_answered"],
+            "device_loss_recovered": bool(
+                svc.provenance()["device_loss_fallbacks"]
+                and not st["degraded_batches_left"]),
+            "jit_steady": steady_jit,
+        })
+
+        # -- phase B: deterministic overload burst ----------------------
+        svc.pause()
+        extra = 8
+        burst_replies: list = []
+        bl = threading.Lock()
+
+        def one_burst():
+            r = svc.lookup_batch(0, [1, 2, 3], deadline_s=5.0)
+            with bl:
+                burst_replies.append(r)
+
+        ths = [threading.Thread(target=one_burst, daemon=True)
+               for _ in range(cfg.max_queue + extra)]
+        for t in ths:
+            t.start()
+        # every request has either enqueued (max_queue) or shed (extra)
+        # BEFORE the drain restarts — the shed count is deterministic
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with bl:
+                n_shed = len(burst_replies)
+            if len(svc._q) + n_shed >= cfg.max_queue + extra:
+                break
+            time.sleep(0.01)
+        svc.unpause()
+        for t in ths:
+            t.join(timeout=30)
+        res["burst"] = {
+            "requests": cfg.max_queue + extra,
+            "shed": sum(1 for r in burst_replies
+                        if r.status == "EBUSY"),
+            "answered": len(burst_replies),
+        }
+        res["burst_shed"] = res["burst"]["shed"]
+    finally:
+        svc.close()
+    h.progress(res)
+
+    # -- phase C: lifetime-engine churn against a live service ---------
+    # generous deadline: on a throttled container the sim's epoch work
+    # and structural-swap tracing hold the GIL for seconds at a time —
+    # exactly the control-plane/client contention being measured
+    chaos = run_chaos(
+        epochs=chaos_epochs,
+        config=ServeConfig(block=256, fill=1024, max_queue=64,
+                           deadline_s=10.0),
+        clients=2, client_batch=128,
+    )
+    res["chaos"] = {k: chaos.get(k) for k in (
+        "epochs", "qps", "p50_s", "p99_s", "dropped", "swaps_ok",
+        "swaps_rejected", "swap_stall_p99_s", "queries_shed",
+        "queries_expired", "sim_violations")}
+    res["jit"] = _jit_delta(jit0)
+    return res
+
+
 DEFAULT_LIFETIME_SCENARIO = (
     "hosts=4,osds_per_host=3,racks=2,pgs=32,ec=2+1,ec_pgs=16,"
     "chunk=256,balance_every=96,balance_max=4,spotcheck_every=48,"
@@ -975,6 +1159,11 @@ def worker() -> None:
     # starve the rebalance/headline stages behind it either
     sched.add("lifetime", lambda h: bench_lifetime(h), priority=75,
               est_s=230, min_budget_s=180, soft_timeout_s=330)
+    # the serving daemon is the north-star heavy-traffic scenario: it
+    # outranks the big mapping configs, and its soft timeout keeps a
+    # wedged dispatcher from starving the stages behind it
+    sched.add("serve", lambda h: bench_serve(h), priority=72,
+              est_s=60, min_budget_s=35, soft_timeout_s=150)
     sched.add("testmappgs_100k_1k", cfg2, priority=70, est_s=60,
               min_budget_s=40)
     # soft timeout: the balancer stage runs AHEAD of the north-star
@@ -1074,6 +1263,8 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         out["balancer"] = _strip_perf(stages["balancer"])
     if "lifetime" in stages:
         out["lifetime"] = _strip_perf(stages["lifetime"])
+    if "serve" in stages:
+        out["serve"] = _strip_perf(stages["serve"])
     if "executables" in stages:
         out["executables"] = stages["executables"]
     q = _quantile_section(stages.get("perf") or {})
@@ -1244,6 +1435,11 @@ SELFTEST_ENV = {
     # injected mid-run device loss and an interrupt+resume digest proof
     "BENCH_LIFETIME_EPOCHS": "510",
     "BENCH_LIFETIME_CK": "BENCH_selftest_lifetime_ck.json",
+    # serve stage small variant: a live service under load with swaps,
+    # an injected device loss, the overload burst, and a short chaos run
+    "BENCH_SERVE_PGS": "2048", "BENCH_SERVE_OSDS": "64",
+    "BENCH_SERVE_SECONDS": "5", "BENCH_SERVE_CLIENTS": "2",
+    "BENCH_SERVE_BLOCK": "512", "BENCH_SERVE_CHAOS_EPOCHS": "6",
     # generous deadline: the bound comes from the workloads being tiny,
     # not from budget-skipping stages (skips would fail the assert); the
     # 510-epoch lifetime scenario alone is ~200s of real dispatches on a
@@ -1259,7 +1455,7 @@ SELFTEST_ENV = {
 
 SELFTEST_STAGES = (
     "init", "ec_jax", "ec_clay", "crushtool_1k_32", "lifetime",
-    "testmappgs_100k_1k", "balancer", "rebalance", "headline",
+    "serve", "testmappgs_100k_1k", "balancer", "rebalance", "headline",
 )
 
 
@@ -1337,6 +1533,11 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
         problems.append(
             "benchdiff did not flag the regression seeded in the fixture "
             "series")
+    elif not any(d["metric"].startswith("serve.")
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the serve regression seeded in the "
+            "fixture series (schema v5 serve.* metrics not folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -1440,6 +1641,48 @@ def selftest() -> int:
         if not lf.get("resume_digest_match"):
             problems.append(
                 "lifetime resume digest != straight-run digest")
+        # serve acceptance gates: sustained QPS with a recorded tail
+        # across live epoch swaps, zero dropped queries, swaps that
+        # never stall readers past the bound, 0 steady compiles,
+        # deterministic EBUSY shedding, and the injected device loss
+        # answered + recovered
+        sv = out.get("serve") or {}
+        if not sv.get("qps", 0) > 0:
+            problems.append("serve recorded no QPS")
+        if sv.get("dropped", -1) != 0:
+            problems.append(
+                f"serve dropped {sv.get('dropped')} queries (wanted 0: "
+                "every query must be answered)")
+        if not sv.get("swaps", 0) >= 2:
+            problems.append(
+                f"serve saw {sv.get('swaps')} live epoch swaps "
+                "(wanted >=2)")
+        if sv.get("swap_stalls", -1) != 0:
+            problems.append(
+                f"serve: {sv.get('swap_stalls')} swap(s) stalled "
+                f"readers past {sv.get('stall_bound_s')}s")
+        if not (sv.get("request_p99_s") or 0) > 0:
+            problems.append("serve recorded no request p99")
+        if sv.get("steady_compiles", -1) != 0:
+            problems.append(
+                f"serve steady state booked "
+                f"{sv.get('steady_compiles')} compile(s) — epoch swaps "
+                "are not operand refreshes")
+        if not sv.get("burst_shed", 0) > 0:
+            problems.append(
+                "serve overload burst shed nothing (admission control "
+                "inert)")
+        if not sv.get("degraded_answered", 0) > 0 \
+                or not sv.get("device_loss_recovered"):
+            problems.append(
+                "serve injected device loss was not answered host-side "
+                "and recovered")
+        cz = sv.get("chaos") or {}
+        if cz.get("dropped", -1) != 0:
+            problems.append(
+                f"serve chaos dropped {cz.get('dropped')} queries")
+        if not cz.get("swaps_ok", 0) > 0:
+            problems.append("serve chaos applied no epoch swaps")
     lint = _selftest_graftlint(problems)
     execs = _selftest_executables(out, problems)
     bdiff = _selftest_benchdiff(problems)
@@ -1465,6 +1708,14 @@ def selftest() -> int:
                      "device_loss_fallbacks", "resume_digest_match",
                      "epochs_per_sec", "cluster_years_per_hour",
                      "degraded_epochs")
+        } or None,
+        "serve": {
+            k: v for k, v in (out.get("serve") or {}).items()
+            if k in ("qps", "request_p50_s", "request_p99_s", "swaps",
+                     "swap_stall_p99_s", "swap_stalls", "dropped",
+                     "steady_compiles", "burst_shed",
+                     "degraded_answered", "device_loss_recovered",
+                     "chaos")
         } or None,
         "benchdiff": bdiff,
     }

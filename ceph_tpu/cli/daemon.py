@@ -28,6 +28,9 @@ Commands (reference names):
     trace flush   write the Chrome trace-event file (CEPH_TPU_TRACE)
     runtime       backend-acquisition provenance (ceph_tpu.runtime:
                   backend, fallback_reason, attempts) + armed faults
+    serve status  live placement-service status (ceph_tpu.serve:
+                  epoch, queue depth, shed/degraded counters,
+                  swap-stall tail)
     help          command list
 
 The in-process self-test pins JAX to CPU (it is a diagnostic path — it

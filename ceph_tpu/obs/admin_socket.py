@@ -25,6 +25,9 @@ protocol here:
                                 pool in THIS process)
     client: "runtime\\n"        server: backend-acquisition provenance
                                 + armed fault points
+    client: "serve status\\n"   server: live placement-service status
+                                (epoch, queue depth, shed/degraded
+                                counters, swap-stall tail) per service
     client: "help\\n"           server: command list JSON
 
 Env-gated like tracing: set `CEPH_TPU_ADMIN_SOCKET=/path/x.asok` and any
@@ -49,8 +52,15 @@ _server: "AdminSocket | None" = None
 
 COMMANDS = (
     "perf dump", "perf schema", "perf reset", "metrics", "cache dump",
-    "bad dump", "explain <pool>.<seed>", "trace flush", "runtime", "help",
+    "bad dump", "explain <pool>.<seed>", "trace flush", "runtime",
+    "serve status", "help",
 )
+
+# concurrent per-connection handler threads (beyond this, accepts wait):
+# a slow `cache dump` analysis must not block a concurrent `perf dump` —
+# the always-answers diagnostic path — but a flood of clients must not
+# spawn unbounded threads either
+MAX_HANDLERS = 8
 
 
 def handle_command(cmd: str) -> str:
@@ -108,13 +118,25 @@ def handle_command(cmd: str) -> str:
             "default_ladder": runtime.default_ladder(),
             "faults_armed": runtime.faults.active(),
         }, indent=1, sort_keys=True)
+    if cmd == "serve status":
+        # the placement-serving daemon's live status (epoch, queue
+        # depth, shed/degraded counters, swap-stall tail) — empty
+        # `services` when this process runs none
+        from ceph_tpu.serve import service as serve_service
+
+        return json.dumps(serve_service.status_dump(), indent=1,
+                          sort_keys=True)
     if cmd == "help":
         return json.dumps(list(COMMANDS))
     return json.dumps({"error": f"unknown command {cmd!r}", "help": list(COMMANDS)})
 
 
 class AdminSocket:
-    """Threaded UNIX stream server; one command per connection."""
+    """Threaded UNIX stream server; one command per connection.
+
+    Each accepted connection runs on its own handler thread (bounded by
+    MAX_HANDLERS): a 5 s `cache dump` analysis no longer blocks a
+    concurrent `perf dump` — the diagnostic path must always answer."""
 
     def __init__(self, path: str):
         self.path = path
@@ -124,6 +146,7 @@ class AdminSocket:
         self.sock.bind(path)
         self.sock.listen(4)
         self._stop = False
+        self._handlers = threading.Semaphore(MAX_HANDLERS)
         self.thread = threading.Thread(
             target=self._serve, name="ceph-tpu-asok", daemon=True
         )
@@ -135,29 +158,42 @@ class AdminSocket:
                 conn, _ = self.sock.accept()
             except OSError:
                 return
-            try:
-                conn.settimeout(5)
-                buf = b""
-                while b"\n" not in buf:
-                    chunk = conn.recv(4096)
-                    if not chunk:
-                        break
-                    buf += chunk
-                cmd = buf.split(b"\n", 1)[0].decode("utf-8", "replace")
-                if cmd:
-                    try:
-                        reply = handle_command(cmd)
-                    except Exception as e:
-                        # the client must see the failure, not an empty
-                        # reply that reads as success
-                        reply = json.dumps(
-                            {"error": f"{type(e).__name__}: {e}"}
-                        )
-                    conn.sendall(reply.encode())
-            except Exception:
-                pass
-            finally:
-                conn.close()
+            self._handlers.acquire()
+            threading.Thread(
+                target=self._handle, args=(conn,),
+                name="ceph-tpu-asok-conn", daemon=True,
+            ).start()
+
+    def _handle(self, conn) -> None:
+        cmd = "<no command read>"
+        try:
+            conn.settimeout(5)
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            cmd = buf.split(b"\n", 1)[0].decode("utf-8", "replace")
+            if cmd:
+                try:
+                    reply = handle_command(cmd)
+                except Exception as e:
+                    # the client must see the failure, not an empty
+                    # reply that reads as success
+                    reply = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    )
+                conn.sendall(reply.encode())
+        except Exception as e:
+            # send failures / recv timeouts: the peer is gone or stuck,
+            # but a silent pass here hides every such failure from the
+            # operator diagnosing exactly this path
+            _log(1, f"admin socket connection failed serving "
+                    f"{cmd!r}: {type(e).__name__}: {e}")
+        finally:
+            self._handlers.release()
+            conn.close()
 
     def close(self) -> None:
         self._stop = True

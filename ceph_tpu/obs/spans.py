@@ -73,6 +73,16 @@ SPANS: dict[str, str] = {
     "sim.epoch": "one lifetime epoch: Incremental apply + remap + "
                  "device accounting + invariant checks",
     "bench.lifetime": "lifetime bench stage body",
+    # serve/ — the placement serving daemon
+    "serve.batch": "one micro-batch: deadline triage + device map + "
+                   "reply delivery (host syncs allowed: the mapper "
+                   "fetches results inside)",
+    "serve.swap": "epoch-swap staging: clone + incremental apply + "
+                  "mapper construction + warm dispatch (off the "
+                  "reader path; the flip itself is swap_stall_seconds)",
+    "serve.chaos": "chaos-client harness: lifetime churn against a "
+                   "live service under client load",
+    "bench.serve": "serve bench stage body",
     # cli/
     "daemon.selftest": "daemon CLI miniature workload",
     # tools/perf_probe.py
@@ -87,6 +97,10 @@ INSTANTS: dict[str, str] = {
     "runtime.acquired": "backend acquisition finished",
     "sharded.make_mesh": "device mesh construction",
     "sim.checkpoint": "a lifetime-sim checkpoint was flushed",
+    "serve.swap_applied": "an epoch swap flipped the active buffer",
+    "serve.degraded": "serve dispatch lost the device; batch answered "
+                      "by the host mapper",
+    "serve.recovered": "serve dispatch returned to the device",
 }
 
 COUNTERS: dict[str, str] = {

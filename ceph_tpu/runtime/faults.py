@@ -81,6 +81,13 @@ FAULT_POINTS: dict[str, str] = {
                    "(qualifier: epoch number)",
     "lifetime_step": "lifetime-sim step start, before the epoch's "
                      "Incremental is built (qualifier: epoch number)",
+    "serve_dispatch": "placement-service micro-batch device dispatch "
+                      "(qualifier: batch sequence number; `lost` "
+                      "degrades the batch to the host mapper, `exit` "
+                      "is the kill/restart test)",
+    "epoch_swap": "placement-service epoch-swap staging, before the "
+                  "new buffer is built (qualifier: target epoch; a "
+                  "firing leaves the old epoch serving)",
 }
 
 _log = subsys_logger("runtime")

@@ -1,0 +1,54 @@
+"""Placement serving daemon — the heavy-traffic scenario.
+
+Everything else in the repo is batch/CLI; this package is the
+persistent service of ROADMAP item 3: answer `pg_to_up_acting_osds`
+and object→PG→OSD queries at high QPS and stay correct and available
+through epoch swaps, overload, device loss, and crash-restart.
+
+    from ceph_tpu.serve import PlacementService, ServeConfig
+
+    svc = PlacementService(osdmap)
+    r = svc.lookup(pool_id, seed)          # r.acting, r.acting_primary
+    svc.apply(incremental)                 # epoch swap, readers undisturbed
+    svc.close()
+
+Design (see `service.py` for the mechanics):
+
+- **Micro-batched dispatch** — queries collect for ≤1 ms (or a fill
+  threshold) and map as ONE fixed-shape device block through the
+  trace-once `PoolMapper`/`_PIPE_CACHE` path, the batched-dispatch
+  framing of "Rateless Codes for Near-Perfect Load Balancing in
+  Distributed Matrix-Vector Multiplication" (PAPERS.md): the device
+  stays saturated while individual requests carry deadlines.
+- **Double-buffered epoch swaps** — an `osd.incremental` apply stages a
+  fresh buffer (map + compiled mappers + refreshed operands) off the
+  reader path, then swaps atomically; readers drain on the old buffer.
+  The reader-visible stall is measured (`swap_stall_seconds` quantile).
+- **Admission control + deadlines** — a bounded queue sheds overload
+  with an explicit EBUSY reply instead of queue collapse; expired
+  requests get ETIMEDOUT.  Queries are answered, never dropped.
+- **Degraded dispatch** — mid-traffic device loss answers the batch
+  through the bit-exact host mapper (provenance recorded) and recovery
+  re-walks back to the device.
+- **Crash-restart** — `runtime.Checkpoint` persists epoch + map blob;
+  a restarted daemon resumes serving the same epoch.
+
+`chaos.py` drives the PR 10 lifetime engine's epoch churn against a
+live service under seeded client load (`python -m ceph_tpu.cli.serve`).
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.serve.service import (
+    PlacementService,
+    Reply,
+    ServeConfig,
+    status_dump,
+)
+
+__all__ = [
+    "PlacementService",
+    "Reply",
+    "ServeConfig",
+    "status_dump",
+]

@@ -1,0 +1,197 @@
+"""Chaos-client harness: lifetime-engine churn against a live service.
+
+The PR 10 lifetime engine (`sim/lifetime.py`) is a ready-made hostile
+control plane: every epoch it evolves one cluster through a seeded
+failure/churn/growth event as a real `Incremental` chain link.  This
+harness points that churn at a live `PlacementService` — after every
+sim epoch the evolved map swaps into the service — while seeded client
+threads keep a query load running and measure what the *clients* see:
+
+    p50/p99 request latency UNDER control-plane churn, QPS, shed and
+    expired counts, and the never-dropped proof (every submitted
+    request got exactly one reply).
+
+This is the contention the online-EC SSD-array study (PAPERS.md) calls
+out: the interesting behavior only appears when control-plane work and
+client traffic compete for the same resources.  Value-only epochs swap
+through the trace-once caches (0 compiles); structural epochs
+(expansion, splits, new pools) pay their compiles in the staging phase,
+off the reader path — the client tail is the witness.
+
+Used by `python -m ceph_tpu.cli.serve chaos`, the `serve` bench stage,
+and the sustained slow-tier test.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.serve.service import PlacementService, ServeConfig
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("serve")
+
+DEFAULT_CHAOS_SCENARIO = (
+    "hosts=4,osds_per_host=3,racks=2,pgs=64,ec=,size=3,"
+    "balance_every=8,balance_max=2,spotcheck_every=0,"
+    "checkpoint_every=0,seed=23,p_split=0,p_pool_create=0,"
+    "p_expand=0,p_remove=0"
+)
+
+
+class _Client:
+    """One seeded query-load thread: random pool/seed batches through
+    the full client path, latencies collected for the percentile
+    summary."""
+
+    def __init__(self, svc: PlacementService, seed: int,
+                 batch: int, stop: threading.Event):
+        self.svc = svc
+        self.rng = np.random.default_rng([seed, 0x5e4e])
+        self.batch = batch
+        self.stop = stop
+        self.latencies: list[float] = []
+        self.submitted = 0
+        self.replied = 0
+        self.by_status: dict[str, int] = {}
+        self.thread = threading.Thread(
+            target=self._run, name=f"serve-client-{seed}", daemon=True)
+
+    def _run(self) -> None:
+        svc = self.svc
+        while not self.stop.is_set():
+            pools = sorted(svc._active.m.pools)
+            pid = int(pools[int(self.rng.integers(len(pools)))])
+            n = svc._active.m.pools[pid].pg_num
+            seeds = self.rng.integers(0, n, size=self.batch).astype(
+                np.uint32)
+            t0 = time.perf_counter()
+            self.submitted += self.batch
+            r = svc.lookup_batch(pid, seeds)
+            self.replied += self.batch
+            self.by_status[r.status] = \
+                self.by_status.get(r.status, 0) + self.batch
+            if r.ok:
+                self.latencies.append(time.perf_counter() - t0)
+
+
+def _pct(vals: list[float], q: float) -> float | None:
+    if not vals:
+        return None
+    return round(float(np.percentile(np.asarray(vals), q)), 6)
+
+
+def run_chaos(scenario: str | None = None, epochs: int | None = None,
+              config: ServeConfig | None = None,
+              checkpoint: str | None = None, resume: bool = False,
+              clients: int = 2, client_batch: int = 256,
+              settle_s: float = 0.02) -> dict:
+    """Run lifetime churn against a live service under client load.
+
+    With `resume=True` the service restores its checkpointed epoch
+    FIRST and the summary records `resumed_epoch` + `sample_digest`
+    before any new churn — the restart-answers-identically witness the
+    kill test compares against the host oracle of the checkpoint."""
+    from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+    sc = Scenario.parse(scenario if scenario is not None
+                        else DEFAULT_CHAOS_SCENARIO)
+    if epochs is not None:
+        sc.epochs = epochs
+    # the serve perf group is process-global; snapshot it so THIS run's
+    # shed/expired/degraded tallies are deltas, not whatever an earlier
+    # service in the same process (e.g. bench phase A/B) accumulated
+    base = dict(obs.perf_dump().get("serve") or {})
+    out: dict = {"scenario": sc.spec()}
+    sim = None
+    if resume:
+        # restart path: prove the resumed epoch answers before churning
+        svc = PlacementService(config=config, checkpoint=checkpoint,
+                               resume=True)
+        out["resumed_epoch"] = svc.epoch
+        out["sample_digest"] = svc.sample_digest()
+    else:
+        sim = LifetimeSim(sc, backend="jax")
+        svc = PlacementService(copy.deepcopy(sim.m), config=config,
+                               checkpoint=checkpoint)
+    stop = threading.Event()
+    pool_threads = [
+        _Client(svc, i, client_batch, stop) for i in range(clients)
+    ]
+    t0 = time.perf_counter()
+    swaps_ok = swaps_rejected = 0
+    try:
+        for c in pool_threads:
+            c.thread.start()
+        with obs.span("serve.chaos", epochs=sc.epochs):
+            if sim is not None:
+                for _ in range(sc.epochs):
+                    step = sim.step()
+                    r = svc.adopt_map(sim.m, reason=step["event"])
+                    if r["ok"]:
+                        swaps_ok += 1
+                    else:
+                        swaps_rejected += 1
+                    # let at least one client batch land per epoch so
+                    # every epoch's map actually served traffic
+                    time.sleep(settle_s)
+                # post-churn grace: the control plane goes quiet and
+                # the clients get the final map to themselves, so the
+                # summary always carries served-ok samples
+                time.sleep(max(10 * settle_s, 0.3))
+            else:
+                # resumed service: a short verification load, no churn
+                time.sleep(max(10 * settle_s, 0.2))
+    finally:
+        stop.set()
+        for c in pool_threads:
+            c.thread.join(timeout=30)
+    wall = time.perf_counter() - t0
+    lat = [v for c in pool_threads for v in c.latencies]
+    submitted = sum(c.submitted for c in pool_threads)
+    replied = sum(c.replied for c in pool_threads)
+    by_status: dict[str, int] = {}
+    for c in pool_threads:
+        for k, v in c.by_status.items():
+            by_status[k] = by_status.get(k, 0) + v
+    st = svc.status()
+
+    def delta(key: str) -> int:
+        v = st.get(key)
+        prev = base.get(key, 0)
+        return (v - prev) if isinstance(v, int) \
+            and isinstance(prev, int) else v
+
+    out.update({
+        "epochs": 0 if sim is None else sim.steps,
+        "final_epoch": svc.epoch,
+        "wall_s": round(wall, 3),
+        "submitted": submitted,
+        "replied": replied,
+        "dropped": submitted - replied,  # must be 0: never-dropped proof
+        "answered_ok": by_status.get("ok", 0),
+        "by_status": by_status,
+        "qps": round(by_status.get("ok", 0) / wall, 1) if wall else 0.0,
+        "p50_s": _pct(lat, 50),
+        "p99_s": _pct(lat, 99),
+        "swaps_ok": swaps_ok,
+        "swaps_rejected": swaps_rejected,
+        # process-wide quantile (phase A's µs-scale flips share it); the
+        # u64 tallies are this run's deltas
+        "swap_stall_p99_s": st.get("swap_stall_p99_s"),
+        "degraded_answered": delta("degraded_answered"),
+        "queries_shed": delta("queries_shed"),
+        "queries_expired": delta("queries_expired"),
+        "provenance": svc.provenance(),
+    })
+    if sim is not None:
+        out["sim_digest"] = sim.digest
+        out["sim_violations"] = len(sim.violations)
+        out["sample_digest"] = svc.sample_digest()
+    svc.close()
+    return out

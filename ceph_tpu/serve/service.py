@@ -1,0 +1,672 @@
+"""The placement service: micro-batcher, double-buffered epoch swaps,
+admission control, degraded dispatch, crash-restart.
+
+Threading model (all bounded, all join-able):
+
+- client threads call `lookup`/`lookup_batch`/`lookup_object`: admission
+  check under the queue lock (full queue -> immediate EBUSY reply), then
+  block on the request's event with a watchdog timeout — the
+  runtime/scheduler idiom: a reply that misses its deadline is abandoned
+  by the waiter (late results are discarded, never delivered);
+- ONE dispatcher thread drains the queue: collects requests for at most
+  `window_s` (or until `fill` queries are pending), groups them by pool,
+  pads each pool's seeds to the fixed `block` shape (cycle-pad: one
+  compiled executable per structure, exactly the repo-wide trace-once
+  contract) and maps them as one device block;
+- epoch swaps run on the caller's thread: stage a complete new buffer
+  (cloned map + incremental applied + PoolMappers constructed + warm
+  dispatch per pool, all off the reader path), then flip the active
+  reference.  The flip is the only reader-visible window and is timed
+  into the `swap_stall_seconds` quantile; in-flight batches keep
+  draining on the buffer they captured.
+
+Degradation contract: a device loss inside the dispatch (real transport
+loss, or the `serve_dispatch` fault point) answers that batch through
+the bit-exact host mapper — same bytes, slower — records provenance,
+and serves the next `degraded_batches` batches host-side before
+re-walking back to the device (`device_recoveries` counts successful
+returns).  Queries are answered, never dropped: every submitted request
+ends in exactly one reply (ok / EBUSY / ETIMEDOUT / ESHUTDOWN / EFAULT).
+
+Crash-restart: every accepted epoch flushes `{epoch, map blob}`
+atomically through `runtime.Checkpoint`; constructing the service with
+`resume=True` restores the map and serves the same epoch.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.core.intmath import pg_mask_for, stable_mod
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.incremental import Incremental, apply_incremental
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgId
+from ceph_tpu.runtime import Checkpoint, faults
+from ceph_tpu.utils import knobs
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("serve")
+
+_L = obs.logger_for("serve")
+_L.add_u64("queries", "queries answered ok (device or degraded host path)")
+_L.add_u64("queries_shed",
+           "queries refused at admission with an EBUSY reply (bounded "
+           "queue full — shed, not queued into collapse)")
+_L.add_u64("queries_expired",
+           "queries answered ETIMEDOUT (deadline budget spent before "
+           "the reply; late results are discarded, never delivered)")
+_L.add_u64("degraded_answered",
+           "queries answered by the bit-exact host mapper after a "
+           "device loss (same bytes, provenance recorded)")
+_L.add_u64("batches", "micro-batches dispatched to the mapper")
+_L.add_u64("epoch_swaps", "epoch swaps applied (staged + flipped)")
+_L.add_u64("swap_rejected",
+           "epoch swaps refused (fault/apply error) with the old epoch "
+           "left serving")
+_L.add_u64("device_recoveries",
+           "dispatches that returned to the device after a degraded "
+           "(host-mapper) spell")
+_L.add_u64("serve_checkpoints", "epoch+map checkpoints flushed")
+_L.add_avg("batch_fill", "queries per dispatched micro-batch")
+_L.add_quantile("request_seconds",
+                "submit-to-reply latency per client request (p50/p99 "
+                "in the dump — the serving tail the QPS target is "
+                "written against)")
+_L.add_quantile("swap_stall_seconds",
+                "reader-visible stall of one epoch swap: the atomic "
+                "buffer flip only — staging runs off the reader path "
+                "(p99 proves the swap never blocks readers)")
+_L.add_time_avg("swap_prepare_seconds",
+                "off-path staging cost of one epoch swap (clone + "
+                "apply + mapper construction + warm dispatch)")
+
+
+@dataclass
+class ServeConfig:
+    """Service tuning; `from_env` reads the CEPH_TPU_SERVE_* knobs."""
+
+    window_s: float = 0.001   # micro-batch collection window (<=1ms)
+    block: int = 1024         # fixed dispatch block width (pad-to-shape)
+    fill: int = 4096          # stop collecting once this many queries wait
+    max_queue: int = 256      # admission bound (pending requests)
+    deadline_s: float = 0.25  # default per-request deadline budget
+    degraded_batches: int = 16  # host batches before re-trying the device
+    checkpoint_every: int = 1   # flush every Nth accepted epoch
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        return cls(
+            window_s=float(knobs.get(
+                "CEPH_TPU_SERVE_WINDOW_US", "1000")) / 1e6,
+            block=int(knobs.get("CEPH_TPU_SERVE_BLOCK", "1024")),
+            fill=int(knobs.get("CEPH_TPU_SERVE_FILL", "4096")),
+            max_queue=int(knobs.get("CEPH_TPU_SERVE_QUEUE", "256")),
+            deadline_s=float(knobs.get(
+                "CEPH_TPU_SERVE_DEADLINE_MS", "250")) / 1e3,
+            degraded_batches=int(knobs.get(
+                "CEPH_TPU_SERVE_DEGRADED_BATCHES", "16")),
+        )
+
+
+@dataclass
+class Reply:
+    """One request's answer.  `status` is always set; rows are present
+    only on "ok".  EBUSY/ETIMEDOUT/ESHUTDOWN/EFAULT are *answers* — the
+    never-dropped contract is that every submit ends in exactly one."""
+
+    status: str                      # ok|EBUSY|ETIMEDOUT|ESHUTDOWN|EFAULT
+    epoch: int = 0
+    source: str = ""                 # "device" | "host" (degraded)
+    up: np.ndarray | None = None          # [n, W] i32, NONE-padded
+    up_primary: np.ndarray | None = None  # [n] i32
+    acting: np.ndarray | None = None      # [n, W] i32
+    acting_primary: np.ndarray | None = None  # [n] i32
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+class _Request:
+    """One queued lookup batch: (pool, seeds) + deadline + reply slot.
+    Exactly ONE reply wins, under the request's own lock: the first
+    `answer()` delivers (later ones — e.g. a batch-wide EFAULT after
+    one pool already answered — are refused), and `abandon()` (the
+    scheduler-watchdog idiom: the waiter gave up) refuses every later
+    delivery, so a request can never be double-counted as both
+    answered and expired."""
+
+    __slots__ = ("pool", "seeds", "deadline", "t0", "event", "reply",
+                 "abandoned", "_lock")
+
+    def __init__(self, pool: int, seeds: np.ndarray, deadline: float):
+        self.pool = pool
+        self.seeds = seeds
+        self.deadline = deadline
+        self.t0 = time.perf_counter()
+        self.event = threading.Event()
+        self.reply: Reply | None = None
+        self.abandoned = False
+        self._lock = threading.Lock()
+
+    def answer(self, reply: Reply) -> bool:
+        """Deliver; False (and no counter advance) when the waiter
+        already abandoned the request or a reply was already won."""
+        with self._lock:
+            if self.abandoned or self.reply is not None:
+                return False
+            self.reply = reply
+        self.event.set()
+        return True
+
+    def abandon(self) -> bool:
+        """Waiter gives up; False when a reply won the race first (the
+        waiter must deliver that reply instead of ETIMEDOUT)."""
+        with self._lock:
+            if self.reply is not None:
+                return False
+            self.abandoned = True
+            return True
+
+
+class _Buffer:
+    """One immutable serving generation: map + compiled mappers.
+
+    Mappers are constructed (and warmed) at staging time, off the
+    reader path; after the flip, readers only dispatch already-compiled
+    executables — a value-only epoch (weights/state/overlay values)
+    books 0 compiles by the `_PIPE_CACHE` trace-once contract."""
+
+    def __init__(self, m: OSDMap, block: int):
+        self.m = m
+        self.epoch = m.epoch
+        self.block = block
+        self._mappers: dict[int, object] = {}
+
+    def mapper(self, pool_id: int):
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        pm = self._mappers.get(pool_id)
+        if pm is None:
+            pm = PoolMapper(self.m, pool_id)
+            self._mappers[pool_id] = pm
+        return pm
+
+    def warm(self) -> None:
+        """One fixed-shape dispatch per pool (fast + rescue kernels) so
+        the first post-flip batch never pays a compile the swap should
+        have paid off-path."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.crush.mapper_jax import RESCUE_PAD
+
+        for pid in sorted(self.m.pools):
+            pm = self.mapper(pid)
+            seeds = (np.arange(self.block) % pm.spec.pg_num).astype(
+                np.uint32)
+            pm.map_batch(seeds)
+            pad = np.zeros(RESCUE_PAD, np.intp)
+            pm.jitted_loop()(
+                jnp.zeros(RESCUE_PAD, jnp.uint32), pm.dev,
+                pm._ov_rows(pad),
+            )
+
+    def host_rows(self, pool_id: int, seeds: np.ndarray):
+        """Bit-exact host replay of a seed batch (the degraded path).
+        Rows use the SAME padded width as the device pipeline, so a
+        degraded reply is byte-identical to the device one."""
+        pm = self._mappers.get(pool_id)
+        W = pm.spec.out_width if pm is not None \
+            else max(self.m.pools[pool_id].size, 1)
+        n = len(seeds)
+        up = np.full((n, W), ITEM_NONE, np.int32)
+        upp = np.full(n, -1, np.int32)
+        act = np.full((n, W), ITEM_NONE, np.int32)
+        actp = np.full(n, -1, np.int32)
+        for i, s in enumerate(seeds):
+            u, u_p, a, a_p = self.m.pg_to_up_acting_osds(
+                PgId(pool_id, int(s)))
+            up[i, : min(len(u), W)] = u[:W]
+            act[i, : min(len(a), W)] = a[:W]
+            upp[i], actp[i] = u_p, a_p
+        return up, upp, act, actp
+
+
+# live services of THIS process, for the admin-socket `serve status`
+# surface (name -> service); a closed service removes itself
+_SERVICES: dict[str, "PlacementService"] = {}
+_services_lock = threading.Lock()
+
+
+def status_dump() -> dict:
+    """Every live service's status — the `serve status` admin payload."""
+    with _services_lock:
+        svcs = dict(_SERVICES)
+    return {"services": {name: s.status() for name, s in svcs.items()}}
+
+
+class PlacementService:
+    """See the module docstring.  `m` may be None with `resume=True`
+    and a checkpoint that holds a serialized epoch."""
+
+    def __init__(self, m: OSDMap | None = None,
+                 config: ServeConfig | None = None,
+                 checkpoint: str | None = None, resume: bool = False,
+                 name: str = "serve"):
+        self.config = config or ServeConfig.from_env()
+        self.name = name
+        self.ck = Checkpoint(checkpoint, resume=resume) \
+            if checkpoint else None
+        self.resumed_from: int | None = None
+        if resume and self.ck is not None:
+            state = self.ck.data.get("serve")
+            if state:
+                from ceph_tpu.osd.codec import decode_osdmap
+
+                m = decode_osdmap(base64.b64decode(state["map_b64"]))
+                self.resumed_from = int(state["epoch"])
+                _log(1, f"serve resumed at epoch {self.resumed_from}")
+        if m is None:
+            raise ValueError(
+                "PlacementService needs a map (or resume=True with a "
+                "checkpoint that holds one)")
+        self._q: deque[_Request] = deque()
+        self._q_lock = threading.Lock()
+        self._q_cv = threading.Condition(self._q_lock)
+        self._apply_lock = threading.Lock()
+        self._stop = False
+        self._paused = False
+        self._batch_seq = 0
+        self._degraded_left = 0
+        self.fallback_events: list[str] = []
+        self._swaps_since_ck = 0
+        self._active = self._stage(m)
+        self._checkpoint()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"ceph-tpu-{name}", daemon=True)
+        self._thread.start()
+        with _services_lock:
+            _SERVICES[name] = self
+
+    # -- client surface ----------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self._active.epoch
+
+    def lookup_batch(self, pool: int, seeds, deadline_s: float | None
+                     = None) -> Reply:
+        """Answer a batch of placement seeds of one pool.  Blocks until
+        the reply or the deadline; an expired wait abandons the request
+        (ETIMEDOUT reply, late dispatcher results discarded)."""
+        seeds = np.asarray(seeds, np.uint32)
+        if not len(seeds):
+            return Reply("EFAULT", epoch=self.epoch,
+                         error="empty seed batch")
+        deadline_s = self.config.deadline_s if deadline_s is None \
+            else deadline_s
+        now = time.perf_counter()
+        req = _Request(pool, seeds, now + deadline_s)
+        with self._q_cv:
+            if self._stop:
+                return Reply("ESHUTDOWN", epoch=self.epoch,
+                             error="service stopped")
+            if len(self._q) >= self.config.max_queue:
+                # shed at admission: an explicit busy answer beats an
+                # unbounded queue whose tail latency collapses for all
+                _L.inc("queries_shed", len(seeds))
+                return Reply("EBUSY", epoch=self.epoch,
+                             error="admission queue full")
+            self._q.append(req)
+            self._q_cv.notify()
+        # watchdogged wait (runtime/scheduler idiom): a margin past the
+        # deadline covers the in-flight dispatch that may still answer
+        if not req.event.wait(deadline_s + 0.25) and req.abandon():
+            _L.inc("queries_expired", len(seeds))
+            return Reply("ETIMEDOUT", epoch=self.epoch,
+                         error=f"no reply within {deadline_s:.3f}s")
+        return req.reply
+
+    def lookup(self, pool: int, seed: int,
+               deadline_s: float | None = None) -> Reply:
+        return self.lookup_batch(pool, [seed], deadline_s)
+
+    def lookup_object(self, pool: int, key: str, ns: str = "",
+                      deadline_s: float | None = None) -> Reply:
+        """object name (+namespace) -> PG -> OSDs (the osdmaptool
+        --test-map-object sequence: rjenkins str hash, stable_mod to a
+        PG seed, then the normal placement path)."""
+        p = self._active.m.pools.get(pool)
+        if p is None:
+            return Reply("EFAULT", epoch=self.epoch,
+                         error=f"no pool {pool}")
+        ps = p.hash_key(key, ns)
+        seed = int(stable_mod(ps, p.pg_num, pg_mask_for(p.pg_num)))
+        return self.lookup(pool, seed, deadline_s)
+
+    # -- epoch swaps -------------------------------------------------------
+
+    def apply(self, inc: Incremental) -> dict:
+        """Apply one `osd.incremental` epoch: stage off the reader path,
+        flip atomically.  A failure (including the `epoch_swap` fault
+        point) leaves the old epoch serving and reports it."""
+        with self._apply_lock:
+            old = self._active
+            try:
+                faults.check("epoch_swap", qual=str(inc.epoch))
+                with obs.span("serve.swap", epoch=inc.epoch), \
+                        _L.time("swap_prepare_seconds"):
+                    m2 = copy.deepcopy(old.m)
+                    m2 = apply_incremental(m2, inc)
+                    buf = self._stage(m2)
+            except Exception as e:
+                _L.inc("swap_rejected")
+                _log(1, f"epoch swap to {inc.epoch} rejected "
+                        f"({type(e).__name__}: {e}); epoch "
+                        f"{old.epoch} keeps serving")
+                return {"ok": False, "epoch": old.epoch,
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+            return self._flip(buf)
+
+    def adopt_map(self, m: OSDMap, reason: str = "") -> dict:
+        """Swap to a complete map (the chaos harness hands the lifetime
+        engine's evolved map over wholesale; same staging + flip path,
+        same fault point)."""
+        with self._apply_lock:
+            old = self._active
+            try:
+                faults.check("epoch_swap", qual=str(m.epoch))
+                with obs.span("serve.swap", epoch=m.epoch), \
+                        _L.time("swap_prepare_seconds"):
+                    buf = self._stage(copy.deepcopy(m))
+            except Exception as e:
+                _L.inc("swap_rejected")
+                _log(1, f"epoch swap to {m.epoch} rejected "
+                        f"({type(e).__name__}: {e}); epoch "
+                        f"{old.epoch} keeps serving ({reason})")
+                return {"ok": False, "epoch": old.epoch,
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+            return self._flip(buf)
+
+    def _stage(self, m: OSDMap) -> _Buffer:
+        buf = _Buffer(m, self.config.block)
+        buf.warm()
+        return buf
+
+    def _flip(self, buf: _Buffer) -> dict:
+        # the only reader-visible window of a swap: one reference
+        # assignment.  Readers that already captured the old buffer
+        # drain on it; the quantile records the bound the bench gates.
+        t0 = time.perf_counter()
+        self._active = buf
+        stall = time.perf_counter() - t0
+        _L.observe("swap_stall_seconds", stall)
+        _L.inc("epoch_swaps")
+        obs.instant("serve.swap_applied", epoch=buf.epoch)
+        self._swaps_since_ck += 1
+        every = self.config.checkpoint_every
+        if every and self._swaps_since_ck >= every:
+            self._checkpoint()
+        return {"ok": True, "epoch": buf.epoch,
+                "swap_stall_s": round(stall, 6)}
+
+    def _checkpoint(self) -> None:
+        if self.ck is None:
+            return
+        from ceph_tpu.osd.codec import encode_osdmap
+
+        self.ck.progress("serve", {
+            "epoch": self._active.epoch,
+            "map_b64": base64.b64encode(
+                encode_osdmap(self._active.m)).decode(),
+        })
+        self._swaps_since_ck = 0
+        _L.inc("serve_checkpoints")
+
+    # -- the dispatcher ----------------------------------------------------
+
+    def pause(self) -> None:
+        """Hold the dispatcher (deterministic overload tests: with the
+        drain stopped, the max_queue+1'th request MUST shed)."""
+        self._paused = True
+
+    def unpause(self) -> None:
+        with self._q_cv:
+            self._paused = False
+            self._q_cv.notify()
+
+    def _collect(self) -> list[_Request]:
+        """Block for work, then gather up to `window_s` / `fill`."""
+        cfg = self.config
+        with self._q_cv:
+            while not self._stop and (not self._q or self._paused):
+                self._q_cv.wait(timeout=0.05)
+            if self._stop:
+                return []
+            batch = [self._q.popleft()]
+            t_end = time.perf_counter() + cfg.window_s
+            n = len(batch[0].seeds)
+            while n < cfg.fill:
+                left = t_end - time.perf_counter()
+                if left <= 0:
+                    break
+                if not self._q:
+                    self._q_cv.wait(timeout=left)
+                    if not self._q:
+                        break
+                req = self._q.popleft()
+                batch.append(req)
+                n += len(req.seeds)
+        return batch
+
+    def _loop(self) -> None:
+        while not self._stop:
+            batch = self._collect()
+            if not batch:
+                continue
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # a bug must not kill the drain:
+                # answer loudly, keep serving
+                _log(0, f"serve dispatch error: {type(e).__name__}: {e}")
+                err = Reply("EFAULT", epoch=self.epoch,
+                            error=f"{type(e).__name__}: {e}"[:200])
+                for req in batch:
+                    req.answer(err)
+        # shutdown drain: pending requests still get an answer
+        with self._q_cv:
+            pending = list(self._q)
+            self._q.clear()
+        bye = Reply("ESHUTDOWN", epoch=self.epoch,
+                    error="service stopped")
+        for req in pending:
+            req.answer(bye)
+
+    def _map_rows(self, buf: _Buffer, pool: int, seeds: np.ndarray,
+                  seq: int):
+        """One pool's seed batch through fixed-shape device blocks, with
+        the degraded-host ladder around the dispatch."""
+        B = self.config.block
+        if self._degraded_left > 0:
+            # degraded spell: serve host-side, count down to recovery
+            self._degraded_left -= 1
+            _L.inc("degraded_answered", len(seeds))
+            return buf.host_rows(pool, seeds), "host"
+        try:
+            # the dispatch boundary: real transport losses raise from
+            # map_batch below; `serve_dispatch` injects the same shapes
+            # (qualifier: batch sequence number, so `exit`/`lost` can be
+            # aimed mid-serve deterministically)
+            faults.check("serve_dispatch", qual=str(seq))
+            pm = buf.mapper(pool)
+            parts = []
+            for i in range(0, len(seeds), B):
+                blk = seeds[i:i + B]
+                sub = pm.map_batch(np.resize(blk, B))
+                parts.append(tuple(o[: len(blk)] for o in sub))
+            rows = tuple(
+                np.concatenate([p[j] for p in parts]) for j in range(4))
+            if self.fallback_events and not self._recovered_logged():
+                _L.inc("device_recoveries")
+                obs.instant("serve.recovered", pool=pool)
+                self.fallback_events.append("recovered: device dispatch "
+                                            "healthy again")
+            return rows, "device"
+        except Exception as e:
+            if not faults.looks_like_device_loss(e):
+                raise
+            # degrade, don't die: host mapper is bit-exact — answer the
+            # in-flight queries, then serve host-side for a spell before
+            # re-walking back to the device
+            self._degraded_left = self.config.degraded_batches
+            msg = (f"epoch {buf.epoch} pool {pool}: "
+                   f"{type(e).__name__}: {e}"[:200] + " -> host mapper")
+            self.fallback_events.append(msg)
+            obs.instant("serve.degraded", pool=pool)
+            _log(1, f"device lost mid-serve; {msg}")
+            _L.inc("degraded_answered", len(seeds))
+            return buf.host_rows(pool, seeds), "host"
+
+    def _recovered_logged(self) -> bool:
+        return bool(self.fallback_events) and \
+            self.fallback_events[-1].startswith("recovered")
+
+    def _dispatch(self, batch: list[_Request]) -> None:
+        buf = self._active  # captured once: swaps flip under us safely
+        self._batch_seq += 1
+        now = time.perf_counter()
+        live: dict[int, list[_Request]] = {}
+        n_live = 0
+        for req in batch:
+            if req.abandoned:
+                continue
+            if now > req.deadline:
+                if req.answer(Reply(
+                        "ETIMEDOUT", epoch=buf.epoch,
+                        error="deadline budget spent in the queue")):
+                    _L.inc("queries_expired", len(req.seeds))
+                continue
+            if req.pool not in buf.m.pools:
+                req.answer(Reply("EFAULT", epoch=buf.epoch,
+                                 error=f"no pool {req.pool}"))
+                continue
+            live.setdefault(req.pool, []).append(req)
+            n_live += len(req.seeds)
+        if not live:
+            return
+        _L.inc("batches")
+        _L.observe("batch_fill", n_live)
+        with obs.span("serve.batch", queries=n_live, pools=len(live)):
+            for pool, reqs in live.items():
+                seeds = np.concatenate([r.seeds for r in reqs])
+                rows, source = self._map_rows(
+                    buf, pool, seeds, self._batch_seq)
+                up, upp, act, actp = rows
+                off = 0
+                for r in reqs:
+                    n = len(r.seeds)
+                    delivered = r.answer(Reply(
+                        "ok", epoch=buf.epoch, source=source,
+                        up=up[off:off + n], up_primary=upp[off:off + n],
+                        acting=act[off:off + n],
+                        acting_primary=actp[off:off + n],
+                    ))
+                    if delivered:
+                        _L.inc("queries", n)
+                        _L.observe("request_seconds",
+                                   time.perf_counter() - r.t0)
+                    off += n
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def sample_digest(self, per_pool: int = 64) -> str:
+        """SHA-256 over the replies to a deterministic query sample of
+        every pool — the restart-answers-identically witness: two
+        services serving the same epoch produce the same digest."""
+        import hashlib
+
+        h = hashlib.sha256(str(self.epoch).encode())
+        for pid in sorted(self._active.m.pools):
+            n = self._active.m.pools[pid].pg_num
+            rng = np.random.default_rng([pid, self.epoch])
+            seeds = np.unique(rng.integers(0, n, size=per_pool))
+            r = self.lookup_batch(pid, seeds, deadline_s=30.0)
+            if not r.ok:
+                h.update(f"{pid}:{r.status}".encode())
+                continue
+            h.update(np.ascontiguousarray(r.acting).tobytes())
+            h.update(np.ascontiguousarray(r.acting_primary).tobytes())
+        return h.hexdigest()
+
+    def provenance(self) -> dict:
+        return {
+            "backend": "host-degraded" if self._degraded_left else
+                       "device",
+            "device_loss_fallbacks": sum(
+                1 for e in self.fallback_events
+                if not e.startswith("recovered")),
+            "fallback_events": list(self.fallback_events)[-8:],
+        }
+
+    def status(self) -> dict:
+        # counter fields are the process-global `serve` perf group (the
+        # repo-wide registry idiom); epoch/queue/degraded state is this
+        # service's own
+        d = _L.dump()
+        stall = d.get("swap_stall_seconds") or {}
+        req = d.get("request_seconds") or {}
+        out = {
+            "epoch": self.epoch,
+            "pools": sorted(self._active.m.pools),
+            "queue_depth": len(self._q),
+            "paused": self._paused,
+            "degraded_batches_left": self._degraded_left,
+            "provenance": self.provenance(),
+            "queries": d.get("queries", 0),
+            "queries_shed": d.get("queries_shed", 0),
+            "queries_expired": d.get("queries_expired", 0),
+            "degraded_answered": d.get("degraded_answered", 0),
+            "batches": d.get("batches", 0),
+            "epoch_swaps": d.get("epoch_swaps", 0),
+            "swap_rejected": d.get("swap_rejected", 0),
+            "swap_stall_p99_s": stall.get("p99"),
+            "request_p50_s": req.get("p50"),
+            "request_p99_s": req.get("p99"),
+            "config": {
+                "window_s": self.config.window_s,
+                "block": self.config.block,
+                "fill": self.config.fill,
+                "max_queue": self.config.max_queue,
+                "deadline_s": self.config.deadline_s,
+            },
+        }
+        if self.resumed_from is not None:
+            out["resumed_from"] = self.resumed_from
+        return out
+
+    def close(self) -> None:
+        """Stop accepting, answer everything pending, final checkpoint."""
+        with self._q_cv:
+            self._stop = True
+            self._q_cv.notify_all()
+        self._thread.join(timeout=10)
+        self._checkpoint()
+        with _services_lock:
+            if _SERVICES.get(self.name) is self:
+                del _SERVICES[self.name]
+
+    def __enter__(self) -> "PlacementService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

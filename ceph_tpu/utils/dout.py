@@ -36,6 +36,7 @@ SUBSYS_DEFAULTS = {
     "sim": 1,
     "obs": 1,
     "runtime": 1,
+    "serve": 1,
 }
 
 _levels = dict(SUBSYS_DEFAULTS)

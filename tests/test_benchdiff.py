@@ -88,11 +88,20 @@ def test_seeded_regressions_flagged():
         "lifetime.invariant_violations",       # 0 -> 3
         "lifetime.steady_compiles",            # 0 -> 6
         "lifetime.jit_compiles_per_epoch",     # 0.0538 -> 0.31
+        # serving daemon (v5): seeded load + swap cadence, so the
+        # shed/stall/compile counts and the recovery bit compare raw
+        "serve.steady_shed",                   # 0 -> 37
+        "serve.swap_stalls",                   # 0 -> 2
+        "serve.steady_compiles",               # 0 -> 3
+        "serve.device_loss_recovered",         # the proof bit flipped
+        "serve.chaos.dropped",                 # 0 -> 4: queries dropped
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
         "ec.rs84_encode_gbps_jax",             # EC encode -70%
         "quantiles.pipeline.map_block.p99",    # tail x4
+        "serve.qps",                           # serving rate -71%
+        "serve.request_p99_s",                 # serving tail x7.5
     } <= flagged
     # every flagged throughput/tail metric compared on the same-machine
     # calibration basis, not raw cross-container numbers

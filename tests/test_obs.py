@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import re
 import subprocess
 import sys
@@ -347,6 +348,143 @@ def test_handle_command_perf_reset():
     L.inc("n", 2)
     assert json.loads(handle_command("perf reset")) == {"ok": True}
     assert obs.perf_dump()["t_reset"]["n"] == 0
+
+
+def test_admin_socket_slow_command_does_not_block_concurrent_client(
+        tmp_path, monkeypatch):
+    """Per-connection handler threads: a slow `cache dump`-style command
+    must not block a concurrent `perf dump` — the always-answers
+    diagnostic path."""
+    import threading
+    import time
+
+    from ceph_tpu.obs import admin_socket
+
+    orig = admin_socket.handle_command
+
+    def slowable(cmd):
+        if cmd == "t_slow":
+            time.sleep(1.5)
+            return json.dumps({"slow": True})
+        return orig(cmd)
+
+    monkeypatch.setattr(admin_socket, "handle_command", slowable)
+    srv = admin_socket.start(str(tmp_path / "conc.asok"))
+    try:
+        box: dict = {}
+
+        def slow_client():
+            box["slow"] = admin_socket.client_command(
+                srv.path, "t_slow", timeout=10)
+
+        t = threading.Thread(target=slow_client)
+        t.start()
+        time.sleep(0.2)  # the slow handler is now holding its thread
+        t0 = time.perf_counter()
+        out = admin_socket.client_command(srv.path, "perf dump")
+        fast_dt = time.perf_counter() - t0
+        assert json.loads(out)  # answered
+        assert fast_dt < 1.0, (
+            f"perf dump took {fast_dt:.2f}s behind a slow command — "
+            "connections are being handled inline in the accept loop")
+        t.join(timeout=10)
+        assert json.loads(box["slow"]) == {"slow": True}
+    finally:
+        srv.close()
+
+
+def test_admin_socket_reclaims_stale_socket_file(tmp_path, monkeypatch):
+    """A dead process's leftover socket file must not stop the next
+    process from serving the path."""
+    import socket as socklib
+
+    from ceph_tpu.obs import admin_socket
+
+    path = str(tmp_path / "stale.asok")
+    s = socklib.socket(socklib.AF_UNIX, socklib.SOCK_STREAM)
+    s.bind(path)
+    s.close()  # no unlink: the killed-process shape — file, no listener
+    assert os.path.exists(path)
+    assert not admin_socket._path_serving(path)
+    monkeypatch.setenv("CEPH_TPU_ADMIN_SOCKET", path)
+    monkeypatch.setattr(admin_socket, "_server", None)
+    srv = admin_socket.maybe_start_from_env()
+    try:
+        assert srv is not None
+        out = admin_socket.client_command(path, "help")
+        assert "perf dump" in json.loads(out)
+    finally:
+        if srv is not None:
+            srv.close()
+        admin_socket._server = None
+
+
+def test_admin_socket_never_steals_live_servers_path(
+        tmp_path, monkeypatch):
+    """A client shell with CEPH_TPU_ADMIN_SOCKET still exported imports
+    obs too — it must not unlink the socket of the live process it is
+    about to query (simulated here by clearing the module global while
+    the server object stays alive, the other-process view)."""
+    from ceph_tpu.obs import admin_socket
+
+    path = str(tmp_path / "live.asok")
+    srv = admin_socket.start(path)
+    try:
+        monkeypatch.setenv("CEPH_TPU_ADMIN_SOCKET", path)
+        monkeypatch.setattr(admin_socket, "_server", None)
+        assert admin_socket._path_serving(path)
+        assert admin_socket.maybe_start_from_env() is None
+        # the live server kept its socket and still answers
+        assert os.path.exists(path)
+        out = admin_socket.client_command(path, "help")
+        assert "perf dump" in json.loads(out)
+    finally:
+        monkeypatch.setattr(admin_socket, "_server", srv)
+        srv.close()
+        admin_socket._server = None
+
+
+def test_admin_socket_connection_error_logged_not_swallowed(
+        tmp_path, monkeypatch, capfd):
+    """A per-connection failure (peer vanishes mid-reply) lands in the
+    dout log with the command, instead of the old bare `except: pass`."""
+    import socket as socklib
+    import struct
+    import time
+
+    from ceph_tpu.obs import admin_socket
+
+    orig = admin_socket.handle_command
+
+    def delayed(cmd):
+        if cmd == "t_err":
+            # wait past the client's RST-close, then try a reply too
+            # big for the (dead) socket buffer: sendall must fail
+            time.sleep(0.3)
+            return "x" * (1 << 20)
+        return orig(cmd)
+
+    monkeypatch.setattr(admin_socket, "handle_command", delayed)
+    srv = admin_socket.start(str(tmp_path / "err.asok"))
+    try:
+        c = socklib.socket(socklib.AF_UNIX, socklib.SOCK_STREAM)
+        c.connect(srv.path)
+        c.sendall(b"t_err\n")
+        # SO_LINGER(0): close sends RST — the server's send must error
+        c.setsockopt(socklib.SOL_SOCKET, socklib.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        c.close()
+        deadline = time.time() + 5
+        logged = ""
+        while time.time() < deadline:
+            logged += capfd.readouterr().err
+            if "admin socket connection failed" in logged:
+                break
+            time.sleep(0.05)
+        assert "admin socket connection failed" in logged, logged[-500:]
+        assert "t_err" in logged
+    finally:
+        srv.close()
 
 
 @pytest.mark.slow

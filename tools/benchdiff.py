@@ -54,7 +54,11 @@ from pathlib import Path
 #     invariant violations, steady/jit compiles per epoch, degraded
 #     epochs, resume-digest proof; epochs/s and cluster-years/hour as
 #     hardware-sensitive rates).
-SCHEMA_VERSION = 4
+# v5: adds the `serve` section (placement serving daemon: QPS and
+#     request p50/p99 calibration-normalized; dropped / steady-shed /
+#     swap-stall / steady-compile counts and the degraded-recovery
+#     proof bit structural).
+SCHEMA_VERSION = 5
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -109,8 +113,8 @@ def _from_partial(raw: dict) -> dict:
             ec.update({k: v for k, v in st.items() if k != "perf"})
     if ec:
         rec["ec"] = ec
-    for key in ("balancer", "rebalance", "lifetime", "executables",
-                "quantiles", "schema_version"):
+    for key in ("balancer", "rebalance", "lifetime", "serve",
+                "executables", "quantiles", "schema_version"):
         if key in raw:
             rec[key] = raw[key]
     init = raw.get("init") or {}
@@ -315,6 +319,27 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         True, True)
     put("lifetime.cluster_years_per_hour",
         lf.get("cluster_years_per_hour"), True, True)
+    # serving daemon (v5): the client-visible story.  Load and swap
+    # cadence are seeded, so the never-dropped / shed / stall /
+    # steady-compile counts and the recovery proof bit are semantic
+    # drift when they move — compared raw; QPS and the request tail are
+    # hardware rates — calibration-normalized.
+    sv = rec.get("serve") or {}
+    put("serve.qps", sv.get("qps"), True, True)
+    put("serve.request_p50_s", sv.get("request_p50_s"), False, True)
+    put("serve.request_p99_s", sv.get("request_p99_s"), False, True)
+    put("serve.dropped", sv.get("dropped"), False, False)
+    put("serve.steady_shed", sv.get("steady_shed"), False, False)
+    put("serve.swap_stalls", sv.get("swap_stalls"), False, False)
+    put("serve.steady_compiles", sv.get("steady_compiles"),
+        False, False)
+    put("serve.swaps", sv.get("swaps"), True, False)
+    if isinstance(sv.get("device_loss_recovered"), bool):
+        out["serve.device_loss_recovered"] = (
+            float(sv["device_loss_recovered"]), True, False)
+    cz = sv.get("chaos") or {}
+    put("serve.chaos.dropped", cz.get("dropped"), False, False)
+    put("serve.chaos.p99_s", cz.get("p99_s"), False, True)
     # multichip trajectory (normalized MULTICHIP_r*.json wrappers)
     mc = rec.get("multichip") or {}
     put("multichip.n_devices", mc.get("n_devices"), True, False)
