@@ -230,7 +230,9 @@ def bench_mapping(m, n_pgs: int, reps: int = REPS) -> dict:
 
         @jax.jit
         def rescue_block(ps, dev, mask):
-            _, _, act, actp = vloop(ps, dev, {})
+            # [:4]: the exact kernel's trailing with_raw output is not
+            # a histogram input
+            _, _, act, actp = vloop(ps, dev, {})[:4]
             hist = _hist(act, DV, mask[:, None])
             phist = _hist(actp[:, None], DV, mask[:, None])
             return hist, phist
@@ -797,6 +799,8 @@ def bench_serve(h) -> dict:
                  "clients": clients, "seconds": seconds}
     try:
         jit0 = _jit_counters()  # service staged + warmed above
+        st0 = dict(obs.perf_dump().get("state") or {})
+        sv0 = dict(obs.perf_dump().get("serve") or {})
 
         # -- phase A: steady load + live swaps + injected device loss --
         stop = threading.Event()
@@ -843,6 +847,12 @@ def bench_serve(h) -> dict:
             if r.ok and r.source == "device":
                 break
         steady_jit = _jit_delta(jit0)
+        st1 = dict(obs.perf_dump().get("state") or {})
+        sv1 = dict(obs.perf_dump().get("serve") or {})
+
+        def _d(snap0, snap1, key):
+            return int(snap1.get(key, 0)) - int(snap0.get(key, 0))
+
         lat = [v for c in load for v in c.latencies]
         submitted = sum(c.submitted for c in load)
         replied = sum(c.replied for c in load)
@@ -867,6 +877,16 @@ def bench_serve(h) -> dict:
             "steady_shed": st["queries_shed"],
             "steady_compiles": steady_jit["compiles"]
             + steady_jit["retraces"],
+            # the O(delta) swap proofs: every phase-A swap (value-only
+            # reweights) must stage via ClusterState fork — no full
+            # restage, no state re-key, no full-table device_put
+            "swap_delta_applies": _d(sv0, sv1, "swap_delta_applies"),
+            "swap_full_restages": _d(sv0, sv1, "swap_full_restages"),
+            "swap_state_rebuilds": _d(st0, st1, "full_rebuilds"),
+            "swap_device_put_bytes": _d(st0, st1, "device_put_bytes"),
+            "swap_prepare_avg_s": round(
+                ((d.get("swap_prepare_seconds") or {}).get("avgtime")
+                 or 0.0), 6),
             "degraded_answered": st["degraded_answered"],
             "device_loss_recovered": bool(
                 svc.provenance()["device_loss_fallbacks"]
@@ -975,6 +995,7 @@ def bench_lifetime(h) -> dict:
     ck.unlink(missing_ok=True)
     ck2.unlink(missing_ok=True)
     jit0 = _jit_counters()
+    bal0 = dict(obs.perf_dump().get("balancer") or {})
 
     # run A: straight through, with a device loss injected mid-run and
     # a checkpoint snapshot taken at `stop`
@@ -1003,6 +1024,11 @@ def bench_lifetime(h) -> dict:
     ck2.unlink(missing_ok=True)
 
     tr = out_a["trace_once"]
+    # the ClusterState O(delta) proofs: whole-run apply classification
+    # and the balancer's membership builds served from the shared rows
+    bal1 = dict(obs.perf_dump().get("balancer") or {})
+    builds0 = (bal0.get("build_state_seconds") or {}).get("avgcount", 0)
+    builds1 = (bal1.get("build_state_seconds") or {}).get("avgcount", 0)
     return {
         "scenario": sc.spec(),
         "epochs": out_a["epochs"],
@@ -1017,6 +1043,13 @@ def bench_lifetime(h) -> dict:
         "report": out_a["report"],
         "trace_once": tr,
         "steady_compiles": tr["steady_compiles"],
+        "steady_full_rebuilds": tr["steady_full_rebuilds"],
+        "state": out_a.get("state"),
+        # O(PGs) membership builds the lifetime's balancer epochs paid
+        # (0 when every build rode ClusterState's version-tagged rows)
+        "balancer_builds": int(builds1) - int(builds0),
+        "balancer_state_reuses": int(bal1.get("state_rows_reused", 0))
+        - int(bal0.get("state_rows_reused", 0)),
         "jit_compiles_per_epoch": out_a["jit_compiles_per_epoch"],
         "at_risk_pg_seconds": round(
             out_a["report"]["at_risk_pg_seconds"], 3),
@@ -1538,6 +1571,13 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
         problems.append(
             "benchdiff did not flag the serve regression seeded in the "
             "fixture series (schema v5 serve.* metrics not folded)")
+    elif not any(d["metric"] in ("lifetime.steady_full_rebuilds",
+                                 "serve.swap_full_restages")
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the ClusterState O(delta)-contract "
+            "regression seeded in the fixture series (schema v6 state "
+            "metrics not folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -1634,6 +1674,17 @@ def selftest() -> int:
                 f"lifetime steady epochs booked "
                 f"{lf.get('steady_compiles')} compile(s) — epoch apply "
                 "is not trace-once")
+        if lf.get("steady_full_rebuilds", -1) != 0:
+            problems.append(
+                f"lifetime steady epochs booked "
+                f"{lf.get('steady_full_rebuilds')} ClusterState "
+                "rebuild(s) — epoch apply is not O(delta)")
+        if lf.get("balancer_builds", -1) != 0:
+            problems.append(
+                f"lifetime balancer paid {lf.get('balancer_builds')} "
+                "O(PGs) membership build(s) — build_state_seconds "
+                "should be absent from steady-state balancer rounds "
+                "(ClusterState rows not reused)")
         if not lf.get("device_loss_fallbacks"):
             problems.append(
                 "lifetime injected device loss did not degrade "
@@ -1668,6 +1719,17 @@ def selftest() -> int:
                 f"serve steady state booked "
                 f"{sv.get('steady_compiles')} compile(s) — epoch swaps "
                 "are not operand refreshes")
+        if not sv.get("swap_delta_applies", 0) >= 2:
+            problems.append(
+                f"serve staged only {sv.get('swap_delta_applies')} "
+                "value-only swap(s) via ClusterState delta (wanted >=2)")
+        if sv.get("swap_full_restages", -1) != 0 \
+                or sv.get("swap_state_rebuilds", -1) != 0:
+            problems.append(
+                "serve value-only swaps paid full restages "
+                f"({sv.get('swap_full_restages')}) / state rebuilds "
+                f"({sv.get('swap_state_rebuilds')}) — staging is not "
+                "riding ClusterState deltas")
         if not sv.get("burst_shed", 0) > 0:
             problems.append(
                 "serve overload burst shed nothing (admission control "
@@ -1705,6 +1767,8 @@ def selftest() -> int:
         "lifetime": {
             k: v for k, v in (out.get("lifetime") or {}).items()
             if k in ("epochs", "invariant_violations", "steady_compiles",
+                     "steady_full_rebuilds", "balancer_builds",
+                     "balancer_state_reuses", "state",
                      "device_loss_fallbacks", "resume_digest_match",
                      "epochs_per_sec", "cluster_years_per_hour",
                      "degraded_epochs")
@@ -1713,7 +1777,9 @@ def selftest() -> int:
             k: v for k, v in (out.get("serve") or {}).items()
             if k in ("qps", "request_p50_s", "request_p99_s", "swaps",
                      "swap_stall_p99_s", "swap_stalls", "dropped",
-                     "steady_compiles", "burst_shed",
+                     "steady_compiles", "swap_delta_applies",
+                     "swap_full_restages", "swap_state_rebuilds",
+                     "swap_prepare_avg_s", "burst_shed",
                      "degraded_answered", "device_loss_recovered",
                      "chaos")
         } or None,

@@ -106,7 +106,8 @@ class DeviceState:
 
     def __init__(self, m, osd_weight: dict[int, float],
                  pgs_per_weight: float, only_pools=None, mesh=None,
-                 chunk: int | None = None, cache: dict | None = None):
+                 chunk: int | None = None, cache: dict | None = None,
+                 rows_source=None):
         import jax
         import jax.numpy as jnp
 
@@ -147,23 +148,26 @@ class DeviceState:
             # the kernel depends only on crush structure + bucket weights,
             # both fixed across a rebalance run; the per-OSD in/out/weight
             # vectors are refreshed from m on every build.
-            if cache is not None and pid in cache:
-                pm = cache[pid]
-                pm.refresh_dev()
-            else:
-                pm = PoolMapper(m, pid, overlays=False)
-                if cache is not None:
-                    cache[pid] = pm
-            n = pm.spec.pg_num
-            with obs.span("balancer.map_pool", pool=pid, pgs=n):
-                rows = pm.map_all_device(chunk)
-            seeds, fix_rows = overlay_fixup_rows(
-                m, pid, int(rows.shape[1])
-            )
-            if len(seeds):
-                rows = rows.at[jnp.asarray(seeds)].set(
-                    jnp.asarray(fix_rows)
+            n = m.pools[pid].pg_num
+            rows = rows_source(pid) if rows_source is not None else None
+            if rows is None:
+                if cache is not None and pid in cache:
+                    pm = cache[pid]
+                    pm.refresh_dev()
+                else:
+                    pm = PoolMapper(m, pid, overlays=False)
+                    if cache is not None:
+                        cache[pid] = pm
+                n = pm.spec.pg_num
+                with obs.span("balancer.map_pool", pool=pid, pgs=n):
+                    rows = pm.map_all_device(chunk)
+                seeds, fix_rows = overlay_fixup_rows(
+                    m, pid, int(rows.shape[1])
                 )
+                if len(seeds):
+                    rows = rows.at[jnp.asarray(seeds)].set(
+                        jnp.asarray(fix_rows)
+                    )
             if mesh is not None:
                 npad = -(-n // mesh.devices.size) * mesh.devices.size
                 rows = rows[:min(n, rows.shape[0])]

@@ -17,6 +17,8 @@ work.
 
 from __future__ import annotations
 
+import time
+
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -231,7 +233,14 @@ _L.add_avg("max_deviation", "max abs deviation after each accepted change")
 _L.add_time_avg("round_seconds", "wall time per optimizer round")
 _L.add_quantile("round_hist",
                 "optimizer round wall-time distribution (p50/p99)")
-_L.add_time_avg("build_state_seconds", "O(PGs) membership-state build time")
+_L.add_time_avg("build_state_seconds",
+                "O(PGs) membership-state build time (booked ONLY when "
+                "the build actually re-mapped pools — builds served "
+                "from ClusterState rows book state_rows_reused "
+                "instead)")
+_L.add_u64("state_rows_reused",
+           "membership builds served from the shared ClusterState's "
+           "version-tagged device rows (no O(PGs) mapping pass)")
 
 
 @dataclass
@@ -244,7 +253,7 @@ class UpmapResult:
 
 
 def _build_pgs_by_osd(
-    m: OSDMap, only_pools, use_tpu: bool
+    m: OSDMap, only_pools, use_tpu: bool, rows_source=None
 ) -> dict[int, set]:
     """Map every PG of every (selected) pool; the reference's per-PG loop
     (OSDMap.cc:4652-4665) replaced by the batched pipeline.
@@ -253,12 +262,27 @@ def _build_pgs_by_osd(
     upmap-carrying PGs from the host oracle: the compiled pipeline's
     shape then never depends on how many pg_upmap entries have
     accumulated, so every round of every rebalance run dispatches
-    through one _PIPE_CACHE entry instead of recompiling."""
+    through one _PIPE_CACHE entry instead of recompiling.
+
+    rows_source(pid) -> device rows (a ClusterState provider) replaces
+    the whole mapping pass with the shared version-tagged cache when it
+    answers; pools it declines fall back to the fresh build."""
     pgs_by_osd: dict[int, set] = {}
     for pool_id, pool in sorted(m.pools.items()):
         if only_pools and pool_id not in only_pools:
             continue
-        if use_tpu:
+        cached = rows_source(pool_id) if rows_source is not None \
+            else None
+        if cached is not None:
+            import numpy as _np
+
+            up = _np.asarray(cached)
+            for ps in range(pool.pg_num):
+                pg = PgId(pool_id, ps)
+                for osd in up[ps]:
+                    if osd != ITEM_NONE and osd >= 0:
+                        pgs_by_osd.setdefault(int(osd), set()).add(pg)
+        elif use_tpu:
             import numpy as _np
 
             from ceph_tpu.osd.pipeline_jax import (
@@ -297,6 +321,7 @@ def calc_pg_upmaps(
     backend: str = "sets",
     mesh=None,
     device_cache: dict | None = None,
+    rows_source=None,
 ) -> UpmapResult:
     """Greedy upmap optimization; mutates m.pg_upmap_items.  Returns the
     change set (the reference's pending_inc).  reference OSDMap.cc:4634.
@@ -337,17 +362,39 @@ def calc_pg_upmaps(
         return res
     pgs_per_weight = total_pgs / osd_weight_total
 
+    # a membership build served from the shared ClusterState's cached
+    # rows is NOT an O(PGs) build — it books state_rows_reused, and
+    # build_state_seconds stays a true build-cost signal (the steady
+    # profile criterion: rebalance rounds riding a warm state show no
+    # build_state time at all).  "Served" means the provider actually
+    # ANSWERED every pool: a provider that declines (working copy
+    # diverged) falls back to the O(PGs) build, which must book as one.
+    served = {"hit": 0, "miss": 0}
+
+    def _counted_src(pid):
+        rows = rows_source(pid)
+        served["hit" if rows is not None else "miss"] += 1
+        return rows
+
+    src = _counted_src if rows_source is not None else None
+    t0 = time.perf_counter()
     with obs.span(
-        "balancer.build_state", backend=backend, pgs=total_pgs
-    ), _L.time("build_state_seconds"):
+        "balancer.build_state", backend=backend, pgs=total_pgs,
+        reused=rows_source is not None,
+    ):
         if backend == "device":
             st = DeviceState(
                 m, osd_weight, pgs_per_weight, only_pools=only_pools,
-                mesh=mesh, cache=device_cache,
+                mesh=mesh, cache=device_cache, rows_source=src,
             )
         else:
-            pgs_by_osd = _build_pgs_by_osd(m, only_pools, use_tpu)
+            pgs_by_osd = _build_pgs_by_osd(m, only_pools, use_tpu,
+                                           rows_source=src)
             st = SetState(pgs_by_osd, osd_weight, pgs_per_weight)
+    if src is not None and not served["miss"] and served["hit"]:
+        _L.inc("state_rows_reused")
+    else:
+        _L.observe("build_state_seconds", time.perf_counter() - t0)
 
     osd_deviation, stddev, cur_max_deviation = st.deviations()
     res.stddev, res.max_deviation = stddev, cur_max_deviation

@@ -1904,7 +1904,24 @@ def compile_rule(A: CrushArrays, ruleno: int, result_max: int,
     return fn
 
 
-RESCUE_PAD = 1024  # fixed loop-kernel batch size for flagged lanes
+RESCUE_PAD = 1024  # largest loop-kernel batch size for flagged lanes
+
+# rescue blocks dispatch in ONE small fixed shape: the exact loop
+# kernel's dispatch cost is linear in lanes (a 1024-lane dispatch to
+# rescue a handful of flagged PGs dominated small-pool remap time), its
+# COMPILE cost is ~seconds per shape (so a ladder of tiers multiplies
+# warmup), and chunked 32-lane dispatches price large rescues the same
+# as one wide dispatch would.  One compiled shape, warmed alongside the
+# kernels (ClusterState / serve staging), never compiled mid-steady.
+RESCUE_PADS = (32,)
+
+
+def rescue_pad_for(k: int) -> int:
+    """The rescue block shape (k > the tier chunks over it)."""
+    for p in RESCUE_PADS:
+        if k <= p:
+            return p
+    return RESCUE_PADS[-1]
 
 # cache_key -> jitted batched executable.  Keyed on the kernel's structural
 # signature, NOT the CrushArrays instance: two maps that differ only in

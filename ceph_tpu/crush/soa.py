@@ -78,14 +78,35 @@ def _map_depth(m: CrushMap) -> int:
 
 
 def build_arrays(
-    m: CrushMap, choose_args: Any | int | str | None = None
+    m: CrushMap, choose_args: Any | int | str | None = None,
+    pad_devices: int | None = None, quantize: bool = False,
 ) -> CrushArrays:
-    """Freeze a CrushMap (+ optionally one named choose_args set) to SoA."""
+    """Freeze a CrushMap (+ optionally one named choose_args set) to SoA.
+
+    pad_devices: raise `max_devices` to this bound (identity when lower
+    than the real bound).  Device ids in [real, pad) never occur in a
+    well-formed map's buckets, so padding only widens the weight-vector
+    operand — callers that quantize the bound (ClusterState) keep one
+    compiled kernel across cluster expansion inside the quantum.  The
+    differential-oracle paths build WITHOUT padding: the `item >=
+    max_devices` validity checks then match the host reference exactly
+    even on corrupt maps.
+
+    quantize: additionally pad the bucket-slot axis (B, pow2 floor 8)
+    and the item axis (S, pow2 floor 4).  Pad slots are zero rows no
+    descent can reach (bucket ids bind through items) and pad lanes are
+    masked by the size vector (the module padding policy), so growth —
+    a host added per expansion, a rack gaining hosts — keeps every
+    table SHAPE, and with it every compiled executable, until the
+    quantum is crossed."""
     if isinstance(choose_args, (int, str)):
         choose_args = m.choose_args.get(choose_args)
 
     B = m.max_buckets
     S = max((b.size for b in m.buckets.values()), default=1) or 1
+    if quantize:
+        B = 1 << max(int(B - 1).bit_length(), 3)
+        S = 1 << max(int(S - 1).bit_length(), 2)
     NN = 2
     for b in m.buckets.values():
         if b.alg == BucketAlg.TREE and b.node_weights:
@@ -149,7 +170,7 @@ def build_arrays(
         max_size=S,
         max_nodes=NN,
         positions=P,
-        max_devices=m.max_devices,
+        max_devices=max(m.max_devices, pad_devices or 0),
         max_depth=_map_depth(m),
         tunables=m.tunables,
         rules=tuple(m.rules),

@@ -83,11 +83,15 @@ class MappingState:
     """
 
     def __init__(self, osdmap: OSDMap, pg_stats=None, desc: str = "",
-                 mapper: str = "jax"):
+                 mapper: str = "jax", state=None):
         self.osdmap = osdmap
         self.desc = desc
         self.pg_stats = pg_stats or {}
         self.mapper = mapper
+        # a shared `osd.state.ClusterState`: pools whose mapping inputs
+        # match its version-tagged cache are served without any mapping
+        # dispatch (the lifetime engine hands its own state in)
+        self.state = state
         self._up: dict[int, np.ndarray] = {}
         self._dev: dict[int, object] = {}
 
@@ -97,6 +101,12 @@ class MappingState:
         rows = self._dev.get(pool_id)
         if rows is not None:
             return rows
+        if self.state is not None:
+            src = self.state.rows_source_for(self.osdmap)
+            rows = src(pool_id) if src is not None else None
+            if rows is not None:
+                self._dev[pool_id] = rows
+                return rows
         import jax.numpy as jnp
 
         from ceph_tpu.osd.pipeline_jax import PoolMapper, overlay_fixup_rows

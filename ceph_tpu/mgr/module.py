@@ -283,6 +283,12 @@ class Balancer:
         total_did = 0
         left = max_optimizations
         use_tpu = plan.initial.mapper == "jax"
+        # a shared ClusterState on the MappingState serves membership
+        # rows from its version-tagged cache; the per-pool provider
+        # declines pools whose working-copy overlays already diverged
+        state = getattr(plan.initial, "state", None)
+        rows_source = (state.rows_source_for(m)
+                       if state is not None else None)
         for pool in pools:
             pid = by_name[pool]
             with obs.span("mgr.do_upmap_pool", pool=pid, left=left):
@@ -290,6 +296,7 @@ class Balancer:
                     m, max_deviation=max_deviation, max_iter=left,
                     only_pools={pid}, use_tpu=use_tpu, rng=self.rng,
                     backend=self.get_option("upmap_state_backend"),
+                    rows_source=rows_source,
                 )
             did = res.num_changed
             for pg, items in res.new_pg_upmap_items.items():
@@ -496,10 +503,15 @@ class Balancer:
         )
 
     # -- execution ---------------------------------------------------------
-    def execute(self, plan: Plan, m: OSDMap) -> tuple[int, str]:
+    def execute(self, plan: Plan, m: OSDMap,
+                state=None) -> tuple[int, str]:
         """Apply the plan to `m` through the epoch-delta machinery
         (reference module.py:1192-1230 issues mon commands; here the
-        plan IS an Incremental and application is apply_incremental)."""
+        plan IS an Incremental and application is apply_incremental).
+        With `state` (the ClusterState owning `m`) the delta ALSO lands
+        on device in O(delta): upmap plans scatter into the overlay
+        fixups, compat weight-sets upload their pos_weights planes —
+        no re-key, no full rebuild."""
         inc = plan.finalize_inc()
         if inc.epoch != m.epoch + 1:
             return -errno.ESTALE, (
@@ -507,7 +519,10 @@ class Balancer:
                 "(map changed since the plan was computed)"
             )
         with obs.span("mgr.execute", plan=plan.name, mode=plan.mode):
-            apply_incremental(m, inc)
+            if state is not None and state.m is m:
+                state.apply(inc)
+            else:
+                apply_incremental(m, inc)
         self._diagnose_executed(plan, m)
         return 0, ""
 

@@ -69,6 +69,17 @@ SPANS: dict[str, str] = {
     # runtime/
     "runtime.acquire_backend": "ladder descent to a healthy backend",
     "runtime.probe": "one watchdogged device preflight probe",
+    # osd/state.py — the device-resident ClusterState
+    "state.apply": "one ClusterState.apply: classify + host model "
+                   "advance + O(delta) device scatter (value) or "
+                   "re-key (structural)",
+    "state.rebuild": "structural re-key: CRUSH arrays rebuilt, operand "
+                     "tables re-device_put, mappers reconstructed",
+    "state.rows": "version-tagged device rows (re)build for one pool "
+                  "(mapping dispatch + overlay fixup scatter)",
+    "state.raw_fixup": "raw-kernel refresh of overlay-carrying PGs' "
+                       "descent rows (fixed-shape dispatch, O(overlay) "
+                       "fetch)",
     # sim/lifetime.py
     "sim.epoch": "one lifetime epoch: Incremental apply + remap + "
                  "device accounting + invariant checks",

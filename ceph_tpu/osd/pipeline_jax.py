@@ -37,9 +37,9 @@ from ceph_tpu.core.rjenkins import crush_hash32_2
 from ceph_tpu.crush import mapper_ref
 from ceph_tpu.crush.mapper_jax import (
     FAST_WINDOW_EXTRA,
-    RESCUE_PAD,
     compile_rule,
     device_tables,
+    rescue_pad_for,
 )
 from ceph_tpu.crush.soa import CrushArrays, build_arrays
 from ceph_tpu.crush.types import ITEM_NONE
@@ -232,6 +232,8 @@ def compile_pipeline(
     with_diag: bool = False,
     window_extra: int = FAST_WINDOW_EXTRA,
     pool_operands: bool = False,
+    raw_only: bool = False,
+    with_raw: bool = False,
 ):
     """Build the single-PG mapping function for one pool; vmap/jit-ready.
 
@@ -259,10 +261,27 @@ def compile_pipeline(
     the device-side flight recorder behind PoolMapper.diagnose.
     Requires with_flag; a static plan fact folded into cache_key, so the
     default pipeline's trace and cache entry are untouched.
+
+    raw_only: stop after stage 2 + _remove_nonexistent_osds and return
+    just the raw descent row (plus the unresolved flag under
+    with_flag) — bit-identical to the host `_pg_to_raw_osds` result,
+    NONE-padded to out_width.
+
+    with_raw: append that same raw row as a TRAILING output of the full
+    pipeline — the loop (exact) kernel carries it for free, so the
+    operand ClusterState's overlay fixup reads device-resident raw
+    results from the kernel it already compiled and warmed (no second
+    descent program): the cheap host steps (upmap application, up/down
+    filter, affinity) replay on the fetched O(overlay) rows.  Both are
+    static plan facts in cache_key.
     """
     assert not (with_diag and not with_flag), (
         "with_diag needs with_flag: flagged lanes carry garbage "
         "diagnostics and the caller must mask or host-rescue them"
+    )
+    assert not (raw_only and with_diag), "raw_only excludes with_diag"
+    assert not (with_raw and (raw_only or with_diag or with_flag)), (
+        "with_raw rides the exact (flagless) full pipeline only"
     )
     W = spec.out_width
     R = spec.size
@@ -287,18 +306,27 @@ def compile_pipeline(
         weight = dev["weight"]  # u32[DV]
         aff = dev["primary_affinity"]  # u32[DV]
 
-        def osd_ok(v, tbl):
-            """valid OSDMap id with tbl true (exists()/is_up() lookups)."""
-            return (v >= 0) & (v < MO) & tbl[jnp.clip(v, 0, DV - 1)]
-
         # -- stage 1: placement seed (reference src/osd/osd_types.cc:1798) -
         if pool_operands:
-            pool = dev["pool"]  # u32 scalars: {pool_id, pgp_num, pgp_mask}
+            # u32 scalars: {pool_id, pgp_num, pgp_mask, max_osd}
+            pool = dev["pool"]
             p_pgp, p_mask = pool["pgp_num"], pool["pgp_mask"]
             p_id = pool["pool_id"]
+            # the OSDMap id bound is an OPERAND (and the vector clip
+            # bound comes from the padded operand SHAPE): growing
+            # max_osd inside the padding quantum — cluster expansion —
+            # reuses the compiled executable instead of re-keying
+            mo = pool["max_osd"].astype(jnp.int32)
+            dv = exists.shape[0]
         else:
             p_pgp, p_mask = spec.pgp_num, pgp_mask
             p_id = jnp.uint32(spec.pool_id & 0xFFFFFFFF)
+            mo = MO
+            dv = DV
+
+        def osd_ok(v, tbl):
+            """valid OSDMap id with tbl true (exists()/is_up() lookups)."""
+            return (v >= 0) & (v < mo) & tbl[jnp.clip(v, 0, dv - 1)]
         ps2 = stable_mod(ps, p_pgp, p_mask, xp=jnp)
         if spec.hashpspool:
             pps = _h2(ps2, p_id)
@@ -331,13 +359,17 @@ def compile_pipeline(
             raw = jnp.where(
                 osd_ok(raw, exists) | (raw == ITEM_NONE), raw, ITEM_NONE
             )
+        if raw_only:
+            return (raw, unresolved) if with_flag else raw
+        raw_result = raw  # stage 3 mutates `raw` (upmap); the raw
+        # output is the PRE-overlay row (host _pg_to_raw_osds)
 
         # -- stage 3: upmap (reference src/osd/OSDMap.cc:2465-2509) --------
         def marked_out(v):
             """the reject guard: valid id AND weight 0 (OSDMap.cc:2472,2496)."""
             return (
-                (v != ITEM_NONE) & (v >= 0) & (v < MO)
-                & (weight[jnp.clip(v, 0, DV - 1)] == 0)
+                (v != ITEM_NONE) & (v >= 0) & (v < mo)
+                & (weight[jnp.clip(v, 0, dv - 1)] == 0)
             )
 
         # a pg_upmap entry with an out target aborts the whole _apply_upmap
@@ -378,7 +410,7 @@ def compile_pipeline(
         # -- stage 5: primary affinity (reference src/osd/OSDMap.cc:2537) --
         if with_primary_affinity:
             nonnone = up != ITEM_NONE
-            a = aff[jnp.clip(up, 0, DV - 1)]
+            a = aff[jnp.clip(up, 0, dv - 1)]
             gate = jnp.any(nonnone & (a != DEFAULT_PRIMARY_AFFINITY))
             h = (_h2(pps, up) >> 16).astype(jnp.uint32)
             rejected = nonnone & (a < MAX_PRIMARY_AFFINITY) & (h >= a)
@@ -430,6 +462,8 @@ def compile_pipeline(
             return up, up_primary, acting, acting_primary, unresolved, dg
         if with_flag:
             return up, up_primary, acting, acting_primary, unresolved
+        if with_raw:
+            return up, up_primary, acting, acting_primary, raw_result
         return up, up_primary, acting, acting_primary
 
     # structural signature: everything baked into the trace above (pool
@@ -439,15 +473,17 @@ def compile_pipeline(
     # in operand content (weights, osd state, choose_args values).
     fn.cache_key = (
         "pipe",
-        # with pool_operands the pool identity/pg counts are operands —
-        # structurally identical pools share the executable
+        # with pool_operands the pool identity/pg counts AND the OSDMap
+        # id bound are operands — structurally identical pools (and the
+        # same cluster across expansions inside the vector-padding
+        # quantum) share the executable
         (None if pool_operands else
          (spec.pool_id, spec.pg_num, spec.pgp_num),
          spec.size, spec.can_shift, spec.hashpspool, spec.ruleno,
-         spec.max_osd, spec.out_width),
+         None if pool_operands else spec.max_osd, spec.out_width),
         with_upmap_full, n_upmap_pairs, with_temp, with_primary_temp,
         with_primary_affinity, path, with_flag, with_diag, window_extra,
-        pool_operands,
+        pool_operands, raw_only, with_raw,
         getattr(rule_fn, "cache_key", ("norule", spec.ruleno)),
     )
     fn.host_tables = getattr(rule_fn, "host_tables", {})
@@ -480,19 +516,31 @@ class PoolMapper:
     structurally-identical pipeline (same `cache_key`) was jitted before
     in this process — the per-map tables are runtime operands
     (device_put once here, carried in self.dev["crush"]).
+
+    state: an `osd.state.ClusterState` to share per-map device operands
+    with — the CRUSH arrays/tables (device_put once per structure, by
+    the state) and the per-OSD vectors (scatter-updated in O(delta) by
+    `ClusterState.apply`); refresh_dev then rebinds instead of
+    re-uploading.  Without it the mapper owns its operands as before.
     """
 
     def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True,
                  path: str = "auto", chunk: int | None = DEFAULT_CHUNK,
-                 window_extra: int = FAST_WINDOW_EXTRA):
+                 window_extra: int = FAST_WINDOW_EXTRA, state=None):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
         self.m = m
         self.pool_id = pool_id
         self.window_extra = window_extra
+        self._state = state
+        ca_key = pool_id if pool_id in m.crush.choose_args else -1
         ca = m.crush.choose_args.get(pool_id, m.crush.choose_args.get(-1))
-        self.arrays = build_arrays(m.crush, ca)
+        self._ca_key = ca_key if ca is not None else None
+        if state is not None:
+            self.arrays = state.arrays_for(pool_id)
+        else:
+            self.arrays = build_arrays(m.crush, ca)
         self.ov = build_overlays(m, pool_id) if overlays else Overlays()
         self.spec = PoolSpec.for_pool(
             m, pool_id, extra_width=self.ov.extra_width
@@ -502,13 +550,20 @@ class PoolMapper:
             n_upmap_pairs=self.ov.n_pairs,
             with_temp=self.ov.temp is not None,
             with_primary_temp=self.ov.primary_temp is not None,
-            with_primary_affinity=m.osd_primary_affinity is not None,
+            # state-shared mappers bake the affinity stage ON even while
+            # the map has no affinity table (an all-DEFAULT vector is a
+            # bit-exact no-op): the first destroy/affinity delta then
+            # updates an operand instead of re-keying every kernel
+            with_primary_affinity=(m.osd_primary_affinity is not None
+                                   or state is not None),
         )
         # self.fn is the exact (loop) kernel: path="auto" without a flag
         # resolves to the loop path in compile_rule, so it doubles as the
-        # rescue kernel (jitted_loop)
+        # rescue kernel (jitted_loop).  with_raw: it also carries the
+        # pre-overlay raw descent row as a trailing output (raw_rows /
+        # ClusterState fixups) — for free, no second descent program.
         self.fn = compile_pipeline(
-            self.arrays, self.spec, path=path,
+            self.arrays, self.spec, path=path, with_raw=True,
             window_extra=window_extra, pool_operands=True, **self._pipe_kw
         )
         self._fast = compile_pipeline(
@@ -516,11 +571,16 @@ class PoolMapper:
             window_extra=window_extra, pool_operands=True, **self._pipe_kw,
         )
         # one device_put of this map's tables (fast ⊇ loop: same base
-        # tables, plus the row-level tables only the fast path reads)
-        self._tables_dev = (
-            device_tables(self._fast.host_tables)
-            if self._fast.host_tables else None
-        )
+        # tables, plus the row-level tables only the fast path reads);
+        # state-shared mappers take the state's once-per-structure copy
+        if not self._fast.host_tables:
+            self._tables_dev = None
+        elif state is not None:
+            self._tables_dev = state.device_tables_for(
+                self._ca_key, self._fast
+            )
+        else:
+            self._tables_dev = device_tables(self._fast.host_tables)
         self.cache_key = (self._fast.cache_key, self.fn.cache_key)
         self._cache = _PIPE_CACHE.setdefault(self.cache_key, {})
         self.refresh_dev()
@@ -536,7 +596,25 @@ class PoolMapper:
         reuse a compiled PoolMapper across weight changes (the balancer's
         round cache) can refresh instead of recompiling.  The CRUSH
         operand tables (device-put once at construction) ride along in
-        dev["crush"]."""
+        dev["crush"].  State-shared mappers rebind the ClusterState's
+        scatter-maintained vectors instead of re-uploading anything."""
+        if self._state is not None:
+            vec = self._state.vectors
+            self.dev = {
+                "exists": vec["exists"],
+                "up": vec["up"],
+                "weight": vec["weight"],
+                "primary_affinity": vec["primary_affinity"],
+                "pool": {
+                    "pool_id": jnp.uint32(self.spec.pool_id & 0xFFFFFFFF),
+                    "pgp_num": jnp.uint32(self.spec.pgp_num),
+                    "pgp_mask": jnp.uint32(pg_mask_for(self.spec.pgp_num)),
+                    "max_osd": jnp.uint32(self.m.max_osd),
+                },
+            }
+            if self._tables_dev is not None:
+                self.dev["crush"] = self._tables_dev
+            return
         dv = self.m.frozen_vectors()
         DV = max(self.arrays.max_devices, self.m.max_osd, 1)
         self.dev = {
@@ -552,6 +630,7 @@ class PoolMapper:
                 "pool_id": jnp.uint32(self.spec.pool_id & 0xFFFFFFFF),
                 "pgp_num": jnp.uint32(self.spec.pgp_num),
                 "pgp_mask": jnp.uint32(pg_mask_for(self.spec.pgp_num)),
+                "max_osd": jnp.uint32(self.m.max_osd),
             },
         }
         if self._tables_dev is not None:
@@ -612,6 +691,36 @@ class PoolMapper:
                 )
             self._jdiag = self._cached_jit("diag", self._diag_fn)
         return self._jdiag
+
+    def raw_rows(self, seeds: np.ndarray) -> np.ndarray:
+        """Host-exact raw descent rows [K, out_width] for `seeds` —
+        bit-identical to `OSDMap._pg_to_raw_osds` (descent + nonexistent
+        removal), NONE-padded — read from the exact loop kernel's
+        trailing with_raw output: the SAME compiled executable the
+        rescue path already warms, so raw results cost no extra compile
+        ever.  Dispatched in cycle-padded rescue-tier blocks (a handful
+        of compiled shapes regardless of K)."""
+        assert not (
+            self._pipe_kw["with_upmap_full"]
+            or self._pipe_kw["n_upmap_pairs"]
+            or self._pipe_kw["with_temp"]
+            or self._pipe_kw["with_primary_temp"]
+        ), "raw_rows is an overlay-free path"
+        seeds = np.asarray(seeds)
+        n = len(seeds)
+        if not n:
+            return np.zeros((0, self.spec.out_width), np.int32)
+        jloop = self.jitted_loop()
+        P = rescue_pad_for(n)
+        out = np.empty((n, self.spec.out_width), np.int32)
+        for i in range(0, n, P):
+            blk = seeds[i:i + P]
+            pad = np.resize(blk, P)  # cycle-pad: one shape
+            with obs.span("pipeline.map_block", pgs=len(blk), raw=True):
+                sub = jloop(jnp.asarray(pad, np.uint32), self.dev, {})
+            with obs.span("pipeline.fetch"):
+                out[i:i + P] = np.asarray(sub[4])[: len(blk)]
+        return out
 
     def diagnose(self, ps: np.ndarray | None = None,
                  source: str | None = None, record: bool = True) -> dict:
@@ -779,19 +888,21 @@ class PoolMapper:
             _L.inc("rescue_invocations")
             jloop = self.jitted_loop()
             with obs.span("pipeline.rescue", lanes=len(idx)):
-                P = RESCUE_PAD
+                P = rescue_pad_for(len(idx))
                 for i in range(0, len(idx), P):
                     blk = idx[i:i + P]
-                    # cycle-pad: one compile per shape
+                    # cycle-pad: one compile per shape — for the loop
+                    # kernel AND the scatter-back (duplicated lanes
+                    # write identical rows, so full-block scatters are
+                    # idempotent and never retrace on a new blk length)
                     pad = np.resize(blk, P)
                     sub = jloop(
                         jnp.asarray(ps[pad], np.uint32), self.dev,
                         self._ov_rows(ps[pad]),
                     )
-                    bidx = jnp.asarray(blk)
+                    bidx = jnp.asarray(pad)
                     out = [
-                        o.at[bidx].set(s[: len(blk)])
-                        for o, s in zip(out, sub)
+                        o.at[bidx].set(s) for o, s in zip(out, sub)
                     ]
         with obs.span("pipeline.fetch"):
             return tuple(np.asarray(o) for o in out)
@@ -814,11 +925,16 @@ class PoolMapper:
             or self._pipe_kw["with_primary_temp"]
         ), "map_all_device is an overlay-free path"
         n = self.spec.pg_num
-        B = min(chunk or self.chunk or DEFAULT_CHUNK, n)
+        # block widths quantize to power-of-two classes (floor 32): a
+        # pg_num split then moves the pool to the NEXT class instead of
+        # minting a fresh compiled shape per pg_num, and small pools of
+        # different sizes share executables (cycle-padded lanes beyond
+        # n are discarded below)
+        B = min(chunk or self.chunk or DEFAULT_CHUNK,
+                1 << max(int(n - 1).bit_length(), 5))
         nb = (n + B - 1) // B
         vfast = self.jitted_fast()
         ups, flgs = [], []
-        nflg = jnp.int64(0)
         for i in range(nb):
             ps = jnp.asarray(
                 (np.arange(i * B, (i + 1) * B) % n).astype(np.uint32)
@@ -827,28 +943,33 @@ class PoolMapper:
                 up, _, _, _, flg = vfast(ps, self.dev, {})
             ups.append(up)
             flgs.append(flg)
-            nflg = nflg + flg.sum()
         _L.inc("pgs_mapped", n)  # not nb*B: pad lanes are not real PGs
         rows = (jnp.concatenate(ups) if len(ups) > 1 else ups[0])[:n]
-        if int(nflg):
+        # ONE sync point: the flag fetch itself forces the dispatched
+        # chain (no separate eager reduce + scalar pull)
+        flag_vs = [np.asarray(f) for f in flgs]
+        if any(fv.any() for fv in flag_vs):
             _L.inc("rescue_invocations")
             vloop = self.jitted_loop()
-            flag_vs = [np.asarray(f) for f in flgs]  # fetched pre-span
             n_unres = 0
-            with obs.span("pipeline.rescue", lanes=int(nflg)):
+            with obs.span("pipeline.rescue",
+                          lanes=int(sum(fv.sum() for fv in flag_vs))):
                 for bi, fv in enumerate(flag_vs):
                     if not fv.any():
                         continue
                     idx = np.nonzero(fv)[0] + bi * B
                     idx = idx[idx < n]
                     n_unres += len(idx)
-                    for i in range(0, len(idx), RESCUE_PAD):
-                        blk = idx[i:i + RESCUE_PAD]
-                        pad = np.resize(blk, RESCUE_PAD)  # fixed shape
-                        up, _, _, _ = vloop(
+                    P = rescue_pad_for(len(idx))
+                    for i in range(0, len(idx), P):
+                        blk = idx[i:i + P]
+                        pad = np.resize(blk, P)  # fixed shape
+                        up = vloop(
                             jnp.asarray(pad.astype(np.uint32)), self.dev, {}
-                        )
-                        rows = rows.at[jnp.asarray(blk)].set(up[: len(blk)])
+                        )[0]
+                        # full-block scatter: duplicated cycle-pad lanes
+                        # write identical rows (no per-length retrace)
+                        rows = rows.at[jnp.asarray(pad)].set(up)
             _L.inc("unresolved_pgs", n_unres)
         return rows
 

@@ -1,0 +1,816 @@
+"""One device-resident ClusterState: O(delta) on-device incremental apply.
+
+The paper's thesis is that CRUSH placement is one batched XLA call over
+device-resident operand tables — but historically every subsystem
+rebuilt those tables from the host per map: the pipeline re-device_put
+the full CRUSH pytree per PoolMapper, the balancer rebuilt its
+membership rows per round, mgr eval built its own state, the lifetime
+simulator paid a full rebuild every epoch (plus a host-side descent
+memo), and the serving daemon deepcopied the whole map to stage each
+epoch swap.  This module is the one canonical owner of the per-map
+device operands, shared by all five consumers:
+
+- **the host truth** — the mutable `OSDMap` model, still advanced by
+  `osd.incremental.apply_incremental` (the monitor's epoch chain);
+- **device operands** — the per-OSD exists/up/weight/primary-affinity
+  vectors (one padded set for every pool) and the per-structure CRUSH
+  operand tables (bucket rows, straw2 planes, choose_args weight-sets),
+  each device_put once per structure;
+- **result caches** — per-pool device-resident `up` rows and the raw
+  descent rows of overlay-carrying PGs, tagged with version counters so
+  a consumer can tell "nothing that feeds this pool's mapping changed"
+  without any device work.
+
+`apply(state, Incremental)` classifies each epoch delta:
+
+- **value-only deltas** (reweights, osd up/down/destroy, primary
+  affinity, pg_upmap / pg_temp entries, choose_args weight tweaks
+  arriving as a structurally-identical crush blob) mutate operands ON
+  DEVICE in O(delta): one jitted scatter over the four OSD vectors
+  (`.at[idx].set`, cycle-padded index blocks — 0 compiles after warmup,
+  no full-table device_put), overlay entries as host-dict updates whose
+  device cost is deferred to the O(overlay) fixup, and choose_args
+  tweaks as a pos_weights-plane upload into the existing table pytree.
+  Proven by the `state.delta_applies` / `state.full_rebuilds` /
+  `state.device_put_bytes` counters.
+- **structural changes** (bucket add/remove, pg_num splits, pool
+  create/delete, rule edits, max_osd growth, a first primary-affinity
+  table) re-key the trace-once caches exactly as before: arrays are
+  rebuilt, tables re-uploaded, and `full_rebuilds` books the event.
+
+Overlay fixups ride **device-resident raw results**: the post-descent
+raw row of an upmap-carrying PG comes from the pipeline's `raw_only`
+kernel (bit-identical to `OSDMap._pg_to_raw_osds`), cached on device
+and refetched (O(overlay) rows) only when a descent input changed; the
+cheap host steps (upmap application, up/down filter, primary affinity)
+replay on those few rows — replacing the lifetime simulator's host-side
+`_raw_memo` descent cache.
+
+The CEPH_TPU_STATE_DELTA=0 knob forces every apply down the rebuild
+path — the A/B lever behind the counter-level delta-vs-rebuild tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.incremental import Incremental, apply_incremental
+from ceph_tpu.osd.osdmap import (
+    DEFAULT_PRIMARY_AFFINITY,
+    OSD_EXISTS,
+    OSD_UP,
+    OSDMap,
+)
+from ceph_tpu.osd.types import PgId
+from ceph_tpu.utils import knobs
+
+_L = obs.logger_for("state")
+_L.add_u64("delta_applies",
+           "value-only Incrementals applied on device in O(delta) "
+           "(jitted vector scatters + O(overlay) fixups — no re-key, "
+           "no full-table device_put)")
+_L.add_u64("full_rebuilds",
+           "structural Incrementals (or CEPH_TPU_STATE_DELTA=0) that "
+           "re-keyed the device state: CRUSH arrays rebuilt, operand "
+           "tables re-device_put, mappers reconstructed")
+_L.add_u64("device_put_bytes",
+           "bytes uploaded host->device by state maintenance: delta "
+           "applies count their O(delta) scatter operands, rebuilds "
+           "count the full vector/table upload")
+_L.add_u64("rows_served",
+           "rows() calls answered from the version-tagged device cache "
+           "(no mapping dispatch at all)")
+_L.add_u64("rows_remapped",
+           "rows() calls that re-dispatched the batched mapping because "
+           "a mapping input changed")
+_L.add_u64("raw_refreshes",
+           "overlay raw-row refreshes: one fixed-shape raw-kernel "
+           "dispatch + an O(overlay) fetch, replacing per-seed host "
+           "descents")
+_L.add_u64("value_forks",
+           "value-only forks (serve staging): device tables shared, "
+           "crush/pools host objects shared, O(OSDs) lists copied — no "
+           "full-map deepcopy")
+_L.add_quantile("apply_seconds",
+                "wall time per ClusterState.apply: classification + "
+                "host model advance + device delta (or rebuild)")
+
+_DELTA_PAD = 32  # scatter index blocks cycle-pad to multiples of this:
+                 # one compiled scatter shape per vector length
+
+_SCATTER_ACCTS: dict[tuple, obs.JitAccount] = {}
+
+
+def _scatter_account(dv: int):
+    """The jitted 4-vector scatter, one executable per vector length,
+    registered in the obs executables registry under cache "state"."""
+    key = ("state", "scatter", dv)
+    acct = _SCATTER_ACCTS.get(key)
+    if acct is None:
+        import jax
+
+        def _upd(vec, idx, exists, up, weight, aff):
+            return {
+                "exists": vec["exists"].at[idx].set(exists, mode="drop"),
+                "up": vec["up"].at[idx].set(up, mode="drop"),
+                "weight": vec["weight"].at[idx].set(weight, mode="drop"),
+                "primary_affinity":
+                    vec["primary_affinity"].at[idx].set(aff, mode="drop"),
+            }
+
+        jfn = jax.jit(_upd)
+        rec = obs.executables.register("state", "scatter", key, fn=jfn)
+        acct = _SCATTER_ACCTS[key] = obs.JitAccount(
+            jfn, _L, "scatter", exec_record=rec)
+    return acct
+
+
+# ----------------------------------------------------------- classification
+
+
+def _crush_value_delta(old, new):
+    """If `new` differs from `old` ONLY in choose_args weight-set
+    VALUES (same buckets, rules, tunables, ids, shapes), return True —
+    the delta is a pos_weights-plane upload, not a re-key.  Any other
+    difference returns False (structural)."""
+    from ceph_tpu.crush.soa import build_arrays
+
+    try:
+        a = build_arrays(old, None)
+        b = build_arrays(new, None)
+    except Exception:
+        return False
+    if a.tunables != b.tunables or a.rules != b.rules:
+        return False
+    for f in ("alg", "btype", "size", "bucket_weight", "items",
+              "weights", "sum_weights", "straws", "node_weights",
+              "num_nodes", "arg_ids"):
+        if not np.array_equal(getattr(a, f), getattr(b, f)):
+            return False
+    if sorted(old.choose_args) != sorted(new.choose_args):
+        return False
+    for key, ca_new in new.choose_args.items():
+        ca_old = old.choose_args[key]
+        if sorted(ca_old.ids) != sorted(ca_new.ids) or any(
+                list(ca_old.ids[k]) != list(ca_new.ids[k])
+                for k in ca_new.ids):
+            return False
+        if sorted(ca_old.weight_sets) != sorted(ca_new.weight_sets):
+            return False
+        for bid, rows in ca_new.weight_sets.items():
+            rows_old = ca_old.weight_sets[bid]
+            if len(rows) != len(rows_old) or any(
+                    len(r) != len(ro) for r, ro in zip(rows, rows_old)):
+                return False
+    return True
+
+
+def classify_incremental(inc: Incremental, m: OSDMap):
+    """Classify one epoch delta against the CURRENT map (pre-apply).
+
+    Returns ("delta", info) for value-only incrementals — info carries
+    the changed OSD id set, whether descent inputs changed (`raw`),
+    whether the choose_args planes changed (`pos_weights`), and the
+    pools whose upmap overlay entries changed — or ("rebuild", None)
+    for structural changes that must re-key the trace-once caches."""
+    if inc.fullmap or inc.new_max_osd >= 0:
+        return "rebuild", None
+    if any(pid in m.pools for pid in inc.new_pools):
+        # mutating an EXISTING pool (pg_num split, size change) re-keys
+        # that pool's compiled shapes; a brand-new pool is value-only —
+        # no device operand changes, its caches build lazily on first use
+        return "rebuild", None
+    pos_weights = False
+    if inc.crush:
+        from ceph_tpu.crush.codec import decode_crushmap
+
+        try:
+            new_crush = decode_crushmap(inc.crush)
+        except Exception:
+            return "rebuild", None
+        if not _crush_value_delta(m.crush, new_crush):
+            return "rebuild", None
+        pos_weights = True
+    # a first new_primary_affinity (or a destroy resetting affinity) is
+    # VALUE-ONLY: state-shared mappers bake the affinity stage on from
+    # the start, so the new table is just an operand update
+    osds = (set(inc.new_state) | set(inc.new_weight)
+            | set(inc.new_primary_affinity) | set(inc.new_up_client))
+    if any(o < 0 or o >= m.max_osd for o in osds):
+        return "rebuild", None
+    raw = bool(inc.new_weight) or bool(inc.new_up_client) or pos_weights
+    for osd, s in inc.new_state.items():
+        s = s or OSD_UP
+        if s & OSD_EXISTS:
+            # the EXISTS bit flips in EITHER direction (destroy clears
+            # it, the XOR of a revival sets it) — the descent's
+            # nonexistent-removal input changed, raw caches are stale
+            raw = True
+    pools = {pg.pool for src in (inc.new_pg_upmap, inc.old_pg_upmap,
+                                 inc.new_pg_upmap_items,
+                                 inc.old_pg_upmap_items) for pg in src}
+    return "delta", {
+        "osds": osds,
+        "vec": bool(osds) or pos_weights,
+        "raw": raw,
+        "pos_weights": pos_weights,
+        "upmap_pools": pools,
+        "dropped_pools": set(inc.old_pools),
+    }
+
+
+def value_copy_map(m: OSDMap) -> OSDMap:
+    """O(OSDs + entries) copy of a map that a VALUE-ONLY Incremental
+    chain may then mutate: the crush tree and PgPool objects are shared
+    (value deltas replace, never mutate, them), the per-OSD lists and
+    overlay dicts are copied.  The serve swap path stages value epochs
+    on this instead of a full-map deepcopy."""
+    new = OSDMap.__new__(OSDMap)
+    new.epoch = m.epoch
+    new.crush = m.crush
+    new.max_osd = m.max_osd
+    new.osd_state = list(m.osd_state)
+    new.osd_weight = list(m.osd_weight)
+    new.osd_primary_affinity = (
+        None if m.osd_primary_affinity is None
+        else list(m.osd_primary_affinity))
+    new.pools = dict(m.pools)
+    new.pool_name = dict(m.pool_name)
+    new.pool_max = m.pool_max
+    new.pg_temp = dict(m.pg_temp)
+    new.primary_temp = dict(m.primary_temp)
+    new.pg_upmap = dict(m.pg_upmap)
+    new.pg_upmap_items = dict(m.pg_upmap_items)
+    new.erasure_code_profiles = {
+        k: dict(v) for k, v in m.erasure_code_profiles.items()}
+    wire = getattr(m, "wire", None)
+    if wire is not None:
+        new.wire = dict(wire)
+    return new
+
+
+# ------------------------------------------------------------- ClusterState
+
+
+class ClusterState:
+    """The canonical device-resident cluster state (module docstring).
+
+    Consumers:
+    - `mapper(pid)` — a PoolMapper sharing this state's arrays, tables
+      and vectors (pipeline `_PIPE_CACHE` operands);
+    - `rows(pid)` — device-resident overlay-corrected `up` rows with a
+      version tag (balancer membership, mgr eval, sim accounting);
+    - `apply(inc)` — advance the host model AND the device operands;
+    - `fork(inc)` — a new state for a value-only epoch sharing every
+      immutable device table (serve double-buffered staging).
+    """
+
+    def __init__(self, m: OSDMap, chunk: int | None = None):
+        from ceph_tpu.utils import ensure_jax_backend
+
+        ensure_jax_backend()
+        self.m = m
+        self.chunk = chunk
+        self.delta_enabled = knobs.get("CEPH_TPU_STATE_DELTA", "1") != "0"
+        self._vec_ver = 0
+        self._raw_ver = 0
+        self._overlay_ver: dict[int, int] = {}
+        self._pending_rebuild = False
+        self.full_rebuilds = 0  # instance-level (the perf group is
+        self.delta_applies = 0  # process-global; per-run gates need these)
+        self._build(initial=True)
+
+    # -- build / rebuild ---------------------------------------------------
+
+    def _build(self, initial: bool = False) -> None:
+        with obs.span("state.rebuild", epoch=self.m.epoch,
+                      initial=initial):
+            _L.inc("full_rebuilds")
+            self.full_rebuilds += 1
+            self._arrays: dict = {}       # ca_key -> CrushArrays
+            self._tables: dict = {}       # (ca_key, fast key) -> dev tables
+            self._mappers: dict = {}      # pid -> PoolMapper
+            self._base: dict = {}         # pid -> (vec_ver, rows, skey)
+            self._rows: dict = {}         # pid -> (tag, rows, skey)
+            self._fix: dict = {}          # pid -> (fix_tag, {seed: row})
+            self._raw: dict = {}          # pid -> (key, np rows)
+            self._oracle: dict = {}       # (pid, seed) -> (raw_ver,
+            #                               host raw list, pps)
+            self._warmed: set = set()
+            self._vec_ver += 1
+            self._raw_ver += 1
+            for pid in list(self._overlay_ver):
+                self._overlay_ver[pid] += 1
+            self._upload_vectors()
+            self._pending_rebuild = False
+            # warm the O(delta) scatter (no-op lanes) so the first
+            # value apply after a re-key never books a steady compile
+            import jax.numpy as jnp
+
+            _scatter_account(self.DV)(
+                self.vectors,
+                jnp.full(_DELTA_PAD, self.DV, jnp.int32),
+                jnp.zeros(_DELTA_PAD, bool), jnp.zeros(_DELTA_PAD, bool),
+                jnp.zeros(_DELTA_PAD, jnp.uint32),
+                jnp.full(_DELTA_PAD, DEFAULT_PRIMARY_AFFINITY,
+                         jnp.uint32))
+
+    def _ca_key(self, pid: int):
+        ca = self.m.crush.choose_args
+        if pid in ca:
+            return pid
+        return -1 if -1 in ca else None
+
+    def arrays_for(self, pid: int):
+        """The frozen CrushArrays for this pool's choose_args group —
+        built once per group per structure."""
+        from ceph_tpu.crush.soa import build_arrays
+
+        key = self._ca_key(pid)
+        A = self._arrays.get(key)
+        if A is None:
+            A = self._arrays[key] = build_arrays(
+                self.m.crush, self.m.crush.choose_args.get(key),
+                pad_devices=self.DV, quantize=True)
+        return A
+
+    def device_tables_for(self, ca_key, fast_fn) -> dict:
+        """device_put one structure's operand tables once; keyed by the
+        (choose_args group, CRUSH-rule structure) pair — the tables are
+        rule-level data, so overlay-gate variants of one pool (serve's
+        overlay-carrying mappers vs the overlay-free row mappers) share
+        one upload."""
+        key = (ca_key, fast_fn.cache_key[-1])
+        tabs = self._tables.get(key)
+        if tabs is None:
+            from ceph_tpu.crush.mapper_jax import device_tables
+
+            host = fast_fn.host_tables
+            tabs = self._tables[key] = device_tables(host)
+            _L.inc("device_put_bytes", _tables_nbytes(host))
+        return tabs
+
+    @property
+    def DV(self) -> int:
+        """Quantized device-vector bound: the per-OSD vectors (and the
+        kernels' weight operand) pad to the next power of two (floor
+        32), so cluster expansion INSIDE the quantum keeps every
+        compiled shape — max_osd rides as a kernel operand — and only
+        growth past the quantum re-keys."""
+        n = max(self.m.crush.max_devices, self.m.max_osd, 1)
+        return 1 << max(int(n - 1).bit_length(), 5)
+
+    def _upload_vectors(self) -> None:
+        dv = self.m.frozen_vectors()
+        DV = self.DV
+        import jax.numpy as jnp
+
+        def pad(v, fill):
+            v = np.asarray(v)
+            if v.shape[0] < DV:
+                v = np.concatenate(
+                    [v, np.full(DV - v.shape[0], fill, v.dtype)])
+            _L.inc("device_put_bytes", int(v.nbytes))
+            return jnp.asarray(v[:DV])
+
+        self.vectors = {
+            "exists": pad(dv["exists"], False),
+            "up": pad(dv["up"], False),
+            "weight": pad(dv["weight"], 0),
+            "primary_affinity": pad(
+                dv["primary_affinity"], DEFAULT_PRIMARY_AFFINITY),
+        }
+
+    # -- mappers -----------------------------------------------------------
+
+    def mapper(self, pid: int):
+        """The shared overlay-free PoolMapper for one pool (overlay
+        corrections ride `rows()`; the compiled executables come from
+        `_PIPE_CACHE` as always)."""
+        from ceph_tpu.osd.pipeline_jax import PoolMapper
+
+        if self._pending_rebuild:
+            self._build()
+        pm = self._mappers.get(pid)
+        if pm is None:
+            pm = PoolMapper(self.m, pid, overlays=False,
+                            chunk=self.chunk, state=self)
+            self._mappers[pid] = pm
+        return pm
+
+    def _warm_rescue(self, pm) -> None:
+        """Precompile EVERY rescue tier of the loop kernel for this
+        structure so a later steady epoch's first flagged lane (at any
+        tier) cannot book a compile."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.crush.mapper_jax import RESCUE_PADS
+
+        wk = (pm.cache_key, self.DV)
+        if wk not in self._warmed:
+            for p in RESCUE_PADS:
+                pm.jitted_loop()(jnp.zeros(p, jnp.uint32), pm.dev, {})
+            self._warmed.add(wk)
+
+    # -- rows --------------------------------------------------------------
+
+    def rows_tag(self, pid: int):
+        """Version tag of one pool's `up` rows: equal tags guarantee
+        bit-identical rows (nothing feeding this pool's mapping
+        changed).  Overlay-free pools exclude the raw version, so a
+        reweight-free epoch's upmap churn elsewhere never invalidates
+        them."""
+        if self._overlay_seeds(pid):
+            return (self._vec_ver, self._raw_ver,
+                    self._overlay_ver.get(pid, 0))
+        return (self._vec_ver, None, self._overlay_ver.get(pid, 0))
+
+    def _overlay_seeds(self, pid: int) -> tuple:
+        m = self.m
+        n = m.pools[pid].pg_num
+        return tuple(sorted({
+            pg.seed for pg in list(m.pg_upmap) + list(m.pg_upmap_items)
+            if pg.pool == pid and pg.seed < n
+        }))
+
+    def rows(self, pid: int):
+        """Device-resident `up` rows [pg_num, W] for one pool, overlay
+        PGs corrected — plus the structure key and version tag.
+        Version-cached: a call whose tag is unchanged does NO device
+        work."""
+        if self._pending_rebuild:
+            self._build()
+        tag = self.rows_tag(pid)
+        ent = self._rows.get(pid)
+        if ent is not None and ent[0] == tag:
+            _L.inc("rows_served")
+            return ent[1], ent[2], tag
+        import jax.numpy as jnp
+
+        with obs.span("state.rows", pool=pid):
+            pm = self.mapper(pid)
+            pm.refresh_dev()
+            self._warm_rescue(pm)
+            base_ent = self._base.get(pid)
+            if base_ent is not None and base_ent[0] == self._vec_ver:
+                rows, skey = base_ent[1], base_ent[2]
+            else:
+                rows = pm.map_all_device(self.chunk)
+                skey = (pm.cache_key, int(rows.shape[0]),
+                        int(rows.shape[1]), self.DV)
+                self._base[pid] = (self._vec_ver, rows, skey)
+            fix = self._fixups(pid, pm, int(rows.shape[1]))
+            if fix:
+                from ceph_tpu.crush.mapper_jax import rescue_pad_for
+
+                seeds = np.fromiter(sorted(fix), np.int64, len(fix))
+                stacked = np.stack([fix[int(s)] for s in seeds])
+                # fixed-shape scatter blocks (cycle-padded: duplicated
+                # lanes write identical rows) — the overlay count can
+                # grow every balance epoch without ever retracing
+                P = rescue_pad_for(len(seeds))
+                for i in range(0, len(seeds), P):
+                    sd = np.resize(seeds[i:i + P], P)
+                    vl = np.resize(stacked[i:i + P],
+                                   (P,) + stacked.shape[1:])
+                    rows = rows.at[jnp.asarray(sd)].set(jnp.asarray(vl))
+            self._rows[pid] = (tag, rows, skey)
+        _L.inc("rows_remapped")
+        return rows, skey, tag
+
+    def _fixups(self, pid: int, pm, width: int) -> dict:
+        """{seed: host-exact up row} for this pool's upmap-carrying PGs
+        — device raw rows + the cheap host overlay/filter/affinity
+        steps, cached until a feeding version changes."""
+        seeds = self._overlay_seeds(pid)
+        if not seeds:
+            return {}
+        ftag = (self._vec_ver, self._raw_ver,
+                self._overlay_ver.get(pid, 0))
+        ent = self._fix.get(pid)
+        if ent is not None and ent[0] == ftag:
+            return ent[1]
+        raw = self._raw_rows(pid, pm, seeds)
+        fix = {
+            int(s): self._up_from_raw(pid, int(s), raw[i], width)
+            for i, s in enumerate(seeds)
+        }
+        self._fix[pid] = (ftag, fix)
+        return fix
+
+    def _raw_rows(self, pid: int, pm, seeds: tuple) -> np.ndarray:
+        """Device-resident raw descent rows for the overlay seeds —
+        refetched only when a descent input changed (the O(delta)
+        replacement for host descent memos)."""
+        key = (self._raw_ver, seeds)
+        ent = self._raw.get(pid)
+        if ent is not None and ent[0] == key:
+            return ent[1]
+        with obs.span("state.raw_fixup", pool=pid, seeds=len(seeds)):
+            self._warm_rescue(pm)
+            rows = pm.raw_rows(np.asarray(seeds, np.int64))
+        self._raw[pid] = (key, rows)
+        _L.inc("raw_refreshes")
+        return rows
+
+    def _up_from_raw(self, pid: int, seed: int, raw_row, width: int):
+        """The host tail of the placement pipeline on one device raw
+        row: _apply_upmap → _raw_to_up_osds → _pick_primary →
+        _apply_primary_affinity (reference OSDMap.cc:2667-2715) — bit
+        identical to `pipeline_jax.overlay_fixup_rows`."""
+        m = self.m
+        pool = m.pools[pid]
+        pg = PgId(pid, seed)
+        if pool.can_shift_osds():
+            raw = [int(o) for o in raw_row if o != ITEM_NONE]
+        else:
+            raw = [int(o) for o in raw_row[:pool.size]]
+        pps = pool.raw_pg_to_pps(pg)
+        m._apply_upmap(pool, pg, raw)
+        up = m._raw_to_up_osds(pool, raw)
+        up_primary = m._pick_primary(up)
+        m._apply_primary_affinity(pps, pool, up, up_primary)
+        row = np.full(width, ITEM_NONE, np.int32)
+        row[: min(len(up), width)] = up[:width]
+        return row
+
+    def host_up(self, pid: int, seed: int) -> list[int]:
+        """One PG's host-exact `up` set — the invariant-oracle surface.
+        Overlay-carrying seeds answer from the device-resident fixup
+        rows; everything else replays a HOST-pure descent, memoized by
+        the raw version counter (a chronically-unmapped PG is
+        re-descended once per descent-input change, not once per epoch
+        — the exact job the old event-heuristic `_raw_memo` did, now
+        version-exact).  The periodic spot-check lanes bypass this
+        entirely: they stay an independent host witness."""
+        fix = self._fix.get(pid)
+        seeds = self._overlay_seeds(pid)
+        if seed in seeds and fix is not None and fix[0] == (
+                self._vec_ver, self._raw_ver,
+                self._overlay_ver.get(pid, 0)):
+            row = fix[1].get(seed)
+            if row is not None:
+                return [int(o) for o in row if o != ITEM_NONE]
+        m = self.m
+        pool = m.pools[pid]
+        pg = PgId(pid, int(seed))
+        ent = self._oracle.get((pid, seed))
+        if ent is not None and ent[0] == self._raw_ver:
+            raw, pps = list(ent[1]), ent[2]
+        else:
+            raw, pps = m._pg_to_raw_osds(pool, pg)
+            if len(self._oracle) >= 4096:  # bounded memo
+                self._oracle.clear()
+            self._oracle[(pid, seed)] = (self._raw_ver, list(raw), pps)
+        m._apply_upmap(pool, pg, raw)
+        up = m._raw_to_up_osds(pool, raw)
+        up_primary = m._pick_primary(up)
+        m._apply_primary_affinity(pps, pool, up, up_primary)
+        return up
+
+    # -- apply -------------------------------------------------------------
+
+    def apply(self, inc: Incremental) -> str:
+        """Advance the host model AND the device operands by one epoch
+        delta.  Returns "delta" (value-only, O(delta) device work) or
+        "rebuild" (structural re-key).  A device loss during the device
+        portion leaves the host model advanced and defers the re-key to
+        the next rows()/mapper() access ("deferred") — the caller's
+        mapping dispatch then degrades exactly as a mid-map loss
+        would."""
+        from ceph_tpu.runtime import faults
+
+        with obs.span("state.apply", epoch=inc.epoch), \
+                _L.time("apply_seconds"):
+            kind, info = classify_incremental(inc, self.m)
+            m2 = apply_incremental(self.m, inc)
+            if m2 is not self.m:
+                self.m = m2  # fullmap decode: a fresh map object
+                kind = "rebuild"
+            try:
+                if kind == "rebuild":
+                    self._build()
+                    return "rebuild"
+                if not self.delta_enabled or self._pending_rebuild:
+                    # a rebuild the INCREMENTAL did not warrant (A/B
+                    # knob, or recovery from a lost device): callers'
+                    # steady-epoch accounting must still see it
+                    self._build()
+                    return "forced_rebuild"
+                if self._apply_delta(info):
+                    # the defensive pos_weights shape-drift fallback
+                    # rebuilt after all — book it as what it was
+                    return "rebuild"
+            except Exception as e:
+                if not faults.looks_like_device_loss(e):
+                    raise
+                self._pending_rebuild = True
+                return "deferred"
+            _L.inc("delta_applies")
+            self.delta_applies += 1
+            return "delta"
+
+    def _apply_delta(self, info: dict) -> bool:
+        """Returns True when the defensive pos_weights fallback rebuilt
+        the whole state instead (the caller then reports "rebuild")."""
+        if info["osds"]:
+            self._scatter_vectors(sorted(info["osds"]))
+        if info["pos_weights"]:
+            if self._update_pos_weights():
+                return True
+        if info["vec"]:
+            self._vec_ver += 1
+        if info["raw"]:
+            self._raw_ver += 1
+        for pid in info["upmap_pools"]:
+            self._overlay_ver[pid] = self._overlay_ver.get(pid, 0) + 1
+        for pid in info.get("dropped_pools", ()):
+            for cache in (self._mappers, self._base, self._rows,
+                          self._fix, self._raw):
+                cache.pop(pid, None)
+        return False
+
+    def _scatter_vectors(self, idx: list) -> None:
+        """O(delta) on-device update of the four per-OSD vectors."""
+        import jax.numpy as jnp
+
+        m = self.m
+        DV = self.DV
+        if len(idx) > _DELTA_PAD or len(idx) * 2 >= DV:
+            # a wide delta: one O(OSDs) vector re-upload moves fewer
+            # bytes than scatter operands would, and keeps the scatter
+            # at exactly ONE compiled shape per vector length
+            self._upload_vectors()
+            return
+        pad = _DELTA_PAD
+        ix = np.full(pad, DV, np.int32)  # out-of-range: dropped lanes
+        ex = np.zeros(pad, bool)
+        up = np.zeros(pad, bool)
+        wt = np.zeros(pad, np.uint32)
+        af = np.full(pad, DEFAULT_PRIMARY_AFFINITY, np.uint32)
+        aff = m.osd_primary_affinity
+        for i, o in enumerate(idx):
+            st = m.osd_state[o]
+            ix[i] = o
+            ex[i] = bool(st & OSD_EXISTS)
+            up[i] = bool(st & OSD_EXISTS) and bool(st & OSD_UP)
+            wt[i] = m.osd_weight[o]
+            af[i] = (aff[o] if aff is not None
+                     else DEFAULT_PRIMARY_AFFINITY)
+        _L.inc("device_put_bytes",
+               int(ix.nbytes + ex.nbytes + up.nbytes + wt.nbytes
+                   + af.nbytes))
+        self.vectors = _scatter_account(DV)(
+            self.vectors, jnp.asarray(ix), jnp.asarray(ex),
+            jnp.asarray(up), jnp.asarray(wt), jnp.asarray(af))
+
+    def _update_pos_weights(self) -> bool:
+        """choose_args weight tweaks: refresh the pos_weights planes of
+        every cached table pytree in place (same shapes, same traces —
+        the kernels read the table dict per dispatch).  Returns True
+        when shape drift forced a full rebuild instead (the caller then
+        reports "rebuild", not "delta")."""
+        from ceph_tpu.crush.soa import build_arrays
+
+        import jax.numpy as jnp
+
+        for ca_key in list(self._arrays):
+            # same quantized padding as arrays_for: the refreshed
+            # planes must keep the cached shapes exactly
+            A2 = build_arrays(
+                self.m.crush, self.m.crush.choose_args.get(ca_key),
+                pad_devices=self.DV, quantize=True)
+            old = self._arrays[ca_key]
+            if (A2.pos_weights.shape != old.pos_weights.shape
+                    or not np.array_equal(A2.arg_ids, old.arg_ids)):
+                # shape drift should have classified structural; be safe
+                self._build()
+                return True
+            self._arrays[ca_key] = A2
+        for (ca_key, _), tabs in self._tables.items():
+            A2 = self._arrays.get(ca_key)
+            if A2 is not None and "pos_weights" in tabs:
+                _L.inc("device_put_bytes", int(A2.pos_weights.nbytes))
+                tabs["pos_weights"] = jnp.asarray(A2.pos_weights)
+        for pm in self._mappers.values():
+            pm.arrays = self._arrays.get(self._ca_key(pm.pool_id),
+                                         pm.arrays)
+        return False
+
+    def rows_source_for(self, m2: OSDMap):
+        """A per-pool device-rows provider valid for `m2` — the
+        balancer/mgr surface.  `m2` is typically a working deepcopy of
+        this state's map at the same epoch (a `Plan.osdmap`); the
+        provider answers a pool only while that pool's mapping inputs
+        still match (upmap churn the optimizer applied to OTHER pools
+        doesn't invalidate it).  Returns None when the maps diverge
+        wholesale (different epoch / vectors) — callers then build
+        their own state exactly as before."""
+        if m2 is not self.m and not (
+                m2.epoch == self.m.epoch
+                and m2.max_osd == self.m.max_osd
+                and m2.osd_weight == self.m.osd_weight
+                and m2.osd_state == self.m.osd_state
+                and m2.osd_primary_affinity
+                == self.m.osd_primary_affinity):
+            return None
+
+        def _entries(m, pid):
+            return (
+                {pg: tuple(v) for pg, v in m.pg_upmap.items()
+                 if pg.pool == pid},
+                {pg: tuple(v) for pg, v in m.pg_upmap_items.items()
+                 if pg.pool == pid},
+            )
+
+        def src(pid: int):
+            if pid not in self.m.pools or pid not in m2.pools:
+                return None
+            if (m2.pools[pid].pg_num != self.m.pools[pid].pg_num
+                    or m2.pools[pid].size != self.m.pools[pid].size):
+                return None
+            if m2 is not self.m and \
+                    _entries(m2, pid) != _entries(self.m, pid):
+                return None
+            rows, _, _ = self.rows(pid)
+            return rows
+
+        return src
+
+    # -- forking (serve staging) ------------------------------------------
+
+    def state_tag(self) -> tuple:
+        """Aggregate version tag: equal tags guarantee no mapping-
+        relevant input changed ANYWHERE (vectors, descent inputs, any
+        pool's overlays).  The public surface for callers memoizing
+        whole-map derived checks (the lifetime invariant gates)."""
+        return (self._vec_ver, self._raw_ver,
+                sum(self._overlay_ver.values()))
+
+    def fork(self, inc: Incremental,
+             _classified: tuple | None = None) -> "ClusterState":
+        """A new ClusterState one VALUE-ONLY epoch ahead, sharing every
+        immutable device table with this one (this state is not
+        mutated; readers keep draining on it).  Raises ValueError on a
+        structural incremental — the caller stages those from scratch.
+        `_classified`: a (kind, info) pair from classify_incremental the
+        caller already computed — skips re-classifying (the crush
+        value-delta check freezes the whole map twice per run)."""
+        kind, info = _classified or classify_incremental(inc, self.m)
+        if kind != "delta":
+            raise ValueError("fork() takes value-only incrementals; "
+                             "stage structural epochs via a fresh "
+                             "ClusterState")
+        new = ClusterState.__new__(ClusterState)
+        new.chunk = self.chunk
+        new.delta_enabled = self.delta_enabled
+        new._pending_rebuild = False
+        new.full_rebuilds = 0
+        new.delta_applies = 0
+        new.m = value_copy_map(self.m)
+        apply_incremental(new.m, inc)
+        new._arrays = dict(self._arrays)
+        new._tables = {k: dict(v) for k, v in self._tables.items()}
+        new._mappers = {}
+        new._base = {}
+        new._rows = {}
+        new._fix = {}
+        new._raw = {}
+        new._warmed = set(self._warmed)
+        new._vec_ver = self._vec_ver
+        new._raw_ver = self._raw_ver
+        new._overlay_ver = dict(self._overlay_ver)
+        new.vectors = self.vectors
+        new._apply_delta(info)
+        _L.inc("delta_applies")
+        new.delta_applies += 1
+        _L.inc("value_forks")
+        return new
+
+    # -- introspection -----------------------------------------------------
+
+    def counters(self) -> dict:
+        """The process-global `state` perf group (convenience for bench
+        stage deltas)."""
+        return dict(obs.perf_dump().get("state") or {})
+
+
+def _tables_nbytes(host_tables: dict) -> int:
+    total = 0
+    for k, v in host_tables.items():
+        if k == "rowlvl":
+            for tab in v.values():
+                total += sum(int(a.nbytes) for a in tab.values())
+        else:
+            total += int(np.asarray(v).nbytes)
+    return total
+
+
+__all__ = [
+    "ClusterState",
+    "Incremental",
+    "classify_incremental",
+    "value_copy_map",
+]

@@ -113,7 +113,9 @@ class ShardedClusterMapper:
         axis = self.mesh.axis_names[0]
 
         def local(ps, dev):
-            up, upp, acting, actp = vf(ps, dev, {})
+            # the exact kernel's trailing with_raw output (pre-overlay
+            # descent row) is not sharded state — drop it here
+            up, upp, acting, actp = vf(ps, dev, {})[:4]
             live = ps < pg_num  # padding rows don't count
             hist = _hist(acting, DV, live[:, None])
             phist = _hist(actp[:, None], DV, live[:, None])
@@ -160,7 +162,7 @@ class ShardedClusterMapper:
         axis = self.mesh.axis_names[0]
 
         def local(ps, dev, target_w):
-            _, _, acting, _ = vf(ps, dev, {})
+            _, _, acting, _ = vf(ps, dev, {})[:4]
             live = ps < pg_num
             hist = jax.lax.psum(_hist(acting, DV, live[:, None]), axis)
             # weight-proportional target (reference src/osd/OSDMap.cc:

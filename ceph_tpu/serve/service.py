@@ -14,11 +14,18 @@ Threading model (all bounded, all join-able):
   compiled executable per structure, exactly the repo-wide trace-once
   contract) and maps them as one device block;
 - epoch swaps run on the caller's thread: stage a complete new buffer
-  (cloned map + incremental applied + PoolMappers constructed + warm
-  dispatch per pool, all off the reader path), then flip the active
-  reference.  The flip is the only reader-visible window and is timed
-  into the `swap_stall_seconds` quantile; in-flight batches keep
-  draining on the buffer they captured.
+  off the reader path, then flip the active reference.  VALUE-ONLY
+  epochs (reweights, osd state, overlay values — `osd.state.
+  classify_incremental`) stage by FORKING the active buffer's
+  ClusterState: the O(delta) on-device apply — crush/pools host
+  objects shared instead of deepcopied, vectors scatter-updated,
+  compiled mappers re-bound, warm dispatches only for structures that
+  actually changed (`swap_delta_applies`).  Structural epochs stage
+  from scratch exactly as before (one deepcopy + fresh ClusterState +
+  full warm, `swap_full_restages`).  The flip is the only
+  reader-visible window and is timed into the `swap_stall_seconds`
+  quantile; in-flight batches keep draining on the buffer they
+  captured.
 
 Degradation contract: a device loss inside the dispatch (real transport
 loss, or the `serve_dispatch` fault point) answers that batch through
@@ -75,6 +82,13 @@ _L.add_u64("swap_rejected",
 _L.add_u64("device_recoveries",
            "dispatches that returned to the device after a degraded "
            "(host-mapper) spell")
+_L.add_u64("swap_delta_applies",
+           "value-only epoch swaps staged by ClusterState delta apply: "
+           "no full-map copy, no table re-upload, vectors scatter on "
+           "device in O(delta)")
+_L.add_u64("swap_full_restages",
+           "structural epoch swaps staged from scratch (deepcopy + "
+           "fresh ClusterState + warm dispatches)")
 _L.add_u64("serve_checkpoints", "epoch+map checkpoints flushed")
 _L.add_avg("batch_fill", "queries per dispatched micro-batch")
 _L.add_quantile("request_seconds",
@@ -185,12 +199,18 @@ class _Buffer:
     Mappers are constructed (and warmed) at staging time, off the
     reader path; after the flip, readers only dispatch already-compiled
     executables — a value-only epoch (weights/state/overlay values)
-    books 0 compiles by the `_PIPE_CACHE` trace-once contract."""
+    books 0 compiles by the `_PIPE_CACHE` trace-once contract.
 
-    def __init__(self, m: OSDMap, block: int):
+    `state` is the buffer's ClusterState: the mappers share its device
+    arrays/tables/vectors, so a value-only swap forks it (O(delta)
+    scatter, host crush/pools shared) instead of deepcopying the map
+    and re-uploading every table."""
+
+    def __init__(self, m: OSDMap, block: int, state=None):
         self.m = m
         self.epoch = m.epoch
         self.block = block
+        self.state = state
         self._mappers: dict[int, object] = {}
 
     def mapper(self, pool_id: int):
@@ -198,28 +218,33 @@ class _Buffer:
 
         pm = self._mappers.get(pool_id)
         if pm is None:
-            pm = PoolMapper(self.m, pool_id)
+            pm = PoolMapper(self.m, pool_id, state=self.state)
             self._mappers[pool_id] = pm
         return pm
 
-    def warm(self) -> None:
-        """One fixed-shape dispatch per pool (fast + rescue kernels) so
-        the first post-flip batch never pays a compile the swap should
-        have paid off-path."""
+    def warm_pool(self, pid: int) -> None:
+        """One fixed-shape dispatch for one pool (fast + rescue
+        kernels)."""
         import jax.numpy as jnp
 
-        from ceph_tpu.crush.mapper_jax import RESCUE_PAD
+        from ceph_tpu.crush.mapper_jax import RESCUE_PADS
 
-        for pid in sorted(self.m.pools):
-            pm = self.mapper(pid)
-            seeds = (np.arange(self.block) % pm.spec.pg_num).astype(
-                np.uint32)
-            pm.map_batch(seeds)
-            pad = np.zeros(RESCUE_PAD, np.intp)
+        pm = self.mapper(pid)
+        seeds = (np.arange(self.block) % pm.spec.pg_num).astype(
+            np.uint32)
+        pm.map_batch(seeds)
+        for p in RESCUE_PADS:
+            pad = np.zeros(p, np.intp)
             pm.jitted_loop()(
-                jnp.zeros(RESCUE_PAD, jnp.uint32), pm.dev,
+                jnp.zeros(p, jnp.uint32), pm.dev,
                 pm._ov_rows(pad),
             )
+
+    def warm(self) -> None:
+        """Warm every pool so the first post-flip batch never pays a
+        compile the swap should have paid off-path."""
+        for pid in sorted(self.m.pools):
+            self.warm_pool(pid)
 
     def host_rows(self, pool_id: int, seeds: np.ndarray):
         """Bit-exact host replay of a seed batch (the degraded path).
@@ -359,16 +384,32 @@ class PlacementService:
     def apply(self, inc: Incremental) -> dict:
         """Apply one `osd.incremental` epoch: stage off the reader path,
         flip atomically.  A failure (including the `epoch_swap` fault
-        point) leaves the old epoch serving and reports it."""
+        point) leaves the old epoch serving and reports it.
+
+        Value-only epochs (reweights, osd state, overlay values) stage
+        by FORKING the active buffer's ClusterState: the O(delta)
+        on-device apply — no full-map deepcopy, no table re-upload, no
+        warm dispatches for structures that did not change.  Structural
+        epochs stage from scratch exactly as before."""
+        from ceph_tpu.osd.state import classify_incremental
+
         with self._apply_lock:
             old = self._active
             try:
                 faults.check("epoch_swap", qual=str(inc.epoch))
                 with obs.span("serve.swap", epoch=inc.epoch), \
                         _L.time("swap_prepare_seconds"):
-                    m2 = copy.deepcopy(old.m)
-                    m2 = apply_incremental(m2, inc)
-                    buf = self._stage(m2)
+                    classified = (classify_incremental(inc, old.m)
+                                  if old.state is not None else
+                                  ("rebuild", None))
+                    if classified[0] == "delta":
+                        buf = self._stage_value(old, inc, classified)
+                        _L.inc("swap_delta_applies")
+                    else:
+                        m2 = copy.deepcopy(old.m)
+                        m2 = apply_incremental(m2, inc)
+                        buf = self._stage(m2)
+                        _L.inc("swap_full_restages")
             except Exception as e:
                 _L.inc("swap_rejected")
                 _log(1, f"epoch swap to {inc.epoch} rejected "
@@ -381,7 +422,9 @@ class PlacementService:
     def adopt_map(self, m: OSDMap, reason: str = "") -> dict:
         """Swap to a complete map (the chaos harness hands the lifetime
         engine's evolved map over wholesale; same staging + flip path,
-        same fault point)."""
+        same fault point).  ONE deepcopy — the caller keeps mutating
+        its map — then a full stage: without the Incremental there is
+        nothing to classify, so the delta path cannot apply here."""
         with self._apply_lock:
             old = self._active
             try:
@@ -399,8 +442,38 @@ class PlacementService:
             return self._flip(buf)
 
     def _stage(self, m: OSDMap) -> _Buffer:
-        buf = _Buffer(m, self.config.block)
+        """Full staging: fresh ClusterState (device arrays/tables/
+        vectors uploaded once) + every pool warmed.  The initial
+        buffer, adopt_map, and structural epochs come through here."""
+        state = None
+        try:
+            from ceph_tpu.osd.state import ClusterState
+
+            state = ClusterState(m)
+        except Exception as e:
+            # state construction must never beat the old contract: a
+            # backendless/degraded environment still stages the plain
+            # per-mapper way
+            _log(1, f"serve staging without ClusterState "
+                    f"({type(e).__name__}: {e})")
+        buf = _Buffer(m, self.config.block, state=state)
         buf.warm()
+        return buf
+
+    def _stage_value(self, old: _Buffer, inc: Incremental,
+                     classified: tuple) -> _Buffer:
+        """Value-only staging: fork the active ClusterState (O(delta)
+        on-device apply, crush/pools host objects shared) and warm ONLY
+        pools whose compiled structure changed (an overlay gate
+        flipping on) — a plain reweight epoch stages with zero mapping
+        dispatches and zero full-table device_puts."""
+        st2 = old.state.fork(inc, _classified=classified)
+        buf = _Buffer(st2.m, self.config.block, state=st2)
+        for pid in sorted(st2.m.pools):
+            pm_old = old._mappers.get(pid)
+            if pm_old is None or \
+                    buf.mapper(pid).cache_key != pm_old.cache_key:
+                buf.warm_pool(pid)
         return buf
 
     def _flip(self, buf: _Buffer) -> dict:
@@ -639,6 +712,8 @@ class PlacementService:
             "batches": d.get("batches", 0),
             "epoch_swaps": d.get("epoch_swaps", 0),
             "swap_rejected": d.get("swap_rejected", 0),
+            "swap_delta_applies": d.get("swap_delta_applies", 0),
+            "swap_full_restages": d.get("swap_full_restages", 0),
             "swap_stall_p99_s": stall.get("p99"),
             "request_p50_s": req.get("p50"),
             "request_p99_s": req.get("p99"),

@@ -23,16 +23,23 @@ module composes every subsystem into one long-running torture test:
   the equality witness: same seed ⇒ same digest, resume ⇒ same final
   digest.
 
-- **Accounting stays device-side.**  Per-epoch degraded / unmapped /
+- **Accounting stays device-side, and epoch state is O(delta).**  The
+  per-map device operands live in ONE `osd.state.ClusterState` shared
+  with the balancer and mgr: epoch deltas apply ON DEVICE in O(delta)
+  (vector scatters, overlay fixups from device-resident raw results),
+  and version tags let an epoch that did not touch a pool's mapping
+  skip its remap AND its stats entirely — digest-exactly, since equal
+  tags guarantee bit-identical rows.  Per-epoch degraded / unmapped /
   at-risk / moved / remapped tallies reduce ON DEVICE
   (`core/reduce.py`); only a handful of int64 scalars are fetched per
   pool per epoch.  Compiled pipelines come from `_PIPE_CACHE`
   (trace-once): a steady epoch — values changed, structure unchanged —
-  must book **0 compiles**, proven by the `pipe_cache_*` / JitAccount
-  counters and recorded per run in the `trace_once` summary.  Epochs
-  that genuinely change structure (expansion, removal, splits crossing
-  a block-shape boundary, the first balancer pass over a new overlay
-  layout) are classified `structural` and excluded from that gate.
+  must book **0 compiles and 0 state rebuilds**, proven by the
+  `pipe_cache_*` / JitAccount / `state.*` counters and recorded per
+  run in the `trace_once` summary.  Epochs that genuinely change
+  structure (expansion, removal, splits crossing a block-shape
+  boundary, the first balancer pass over a new overlay layout) are
+  classified `structural` and excluded from that gate.
 
 - **EC-aware data-at-risk windows.**  A PG is *at risk* when its up set
   has lost more chunks than the pool tolerates (EC profile: > m chunks;
@@ -495,13 +502,19 @@ class LifetimeSim:
         self.host_seq = scenario.hosts
         self.expanded = 0
         self.resumed_from: int | None = None
-        # in-process caches (never checkpointed: cache state, not truth)
-        self._pm_cache: dict[int, object] = {}
-        self._raw_memo: dict[tuple, tuple] = {}
-        self._prev_rows: dict[int, object] = {}
+        # in-process caches (never checkpointed: cache state, not truth).
+        # self.state is the device-resident ClusterState (jax backend):
+        # per-OSD vectors scatter-updated in O(delta), per-pool rows
+        # version-tagged so unchanged pools skip ALL device work.
+        self.state = None
+        self._prev_rows: dict[int, tuple] = {}   # pid -> (tag, rows)
+        self._stats_cache: dict[int, tuple] = {}  # pid -> (tag, row-stats)
+        self.steady_full_rebuilds = 0
         self._prev_skeys: frozenset | None = None
         self._last_balance_key = None
-        self._loop_warm: set = set()
+        self._overlay_checked: dict[int, tuple] = {}
+        self._pg_temp_checked = None
+        self._structural_apply = False
         self._steps_this_proc = 0
         self._wall_this_proc = 0.0
         self._sim_this_proc = 0.0
@@ -541,6 +554,7 @@ class LifetimeSim:
             "steady_epochs": self.steady_epochs,
             "steady_compiles": self.steady_compiles,
             "steady_pipe_misses": self.steady_pipe_misses,
+            "steady_full_rebuilds": self.steady_full_rebuilds,
             "total_compiles": self.total_compiles,
             "flap_down": {str(k): v for k, v in self.flap_down.items()},
             "outages": self.outages,
@@ -574,6 +588,8 @@ class LifetimeSim:
         self.steady_epochs = int(state["steady_epochs"])
         self.steady_compiles = int(state["steady_compiles"])
         self.steady_pipe_misses = int(state["steady_pipe_misses"])
+        self.steady_full_rebuilds = int(
+            state.get("steady_full_rebuilds", 0))
         self.total_compiles = int(state["total_compiles"])
         self.flap_down = {int(k): int(v)
                           for k, v in state["flap_down"].items()}
@@ -599,6 +615,16 @@ class LifetimeSim:
         """Map every pool once (rows become epoch 0's `prev`), and
         establish the structure key set the steady-compile gate diffs
         against.  Compiles booked here are warmup, not epoch cost."""
+        if self.backend == "jax":
+            from ceph_tpu.osd.state import ClusterState
+
+            try:
+                self.state = ClusterState(self.m,
+                                          chunk=self.scenario.chunk)
+            except Exception as e:
+                if not faults.looks_like_device_loss(e):
+                    raise
+                self._record_fallback(0, "state", e)
         skeys = set()
         for pid in sorted(self.m.pools):
             try:
@@ -624,106 +650,72 @@ class LifetimeSim:
                 return max(0, pool.size - 1)
         return max(0, pool.size - 1)
 
-    def _pool_mapper(self, pid: int):
-        from ceph_tpu.osd.pipeline_jax import PoolMapper
-
-        pm = self._pm_cache.get(pid)
-        if pm is None:
-            pm = PoolMapper(self.m, pid, overlays=False,
-                            chunk=self.scenario.chunk)
-            self._pm_cache[pid] = pm
-        else:
-            pm.refresh_dev()
-        return pm
-
-    def _overlay_fixup(self, pid: int, width: int):
-        """overlay_fixup_rows with the CRUSH descent memoized: the
-        post-descent raw mapping of an overlay-carrying PG only changes
-        on raw-changing events (weights/crush/pool — see
-        RAW_CHANGING_EVENTS, which clear `_raw_memo`), while the upmap
-        application and up/down filter are cheap and recomputed every
-        epoch.  Bit-identical to `pipeline_jax.overlay_fixup_rows`
-        (same reference sequence, OSDMap.cc:2667-2715); without the
-        memo a long lifetime pays one full host descent per
-        accumulated balancer upmap entry per pool per epoch."""
-        m = self.m
-        pool = m.pools[pid]
-        n = pool.pg_num
-        seeds = sorted({
-            pg.seed for pg in list(m.pg_upmap) + list(m.pg_upmap_items)
-            if pg.pool == pid and pg.seed < n
-        })
-        rows = np.full((len(seeds), width), ITEM_NONE, np.int32)
-        for i, s in enumerate(seeds):
-            up = self._host_up(pid, s)
-            rows[i, : min(len(up), width)] = up[:width]
-        return np.asarray(seeds, np.int64), rows
-
     def _host_up(self, pid: int, seed: int) -> list[int]:
-        """One PG's host-exact `up` set with the descent memoized (see
-        `_overlay_fixup`); the overlay application and up/down filter
-        run fresh every call."""
+        """One PG's host-exact `up` set — the invariant oracle.  On the
+        jax backend the ClusterState answers overlay-carrying seeds
+        from its device-resident raw cache; everything else replays the
+        host descent directly (bounded call sites)."""
+        if self.state is not None:
+            return self.state.host_up(pid, int(seed))
         m = self.m
         pool = m.pools[pid]
         pg = PgId(pid, int(seed))
-        hit = self._raw_memo.get((pid, seed))
-        if hit is None:
-            hit = m._pg_to_raw_osds(pool, pg)
-            self._raw_memo[(pid, seed)] = hit
-        raw, pps = list(hit[0]), hit[1]
+        raw, pps = m._pg_to_raw_osds(pool, pg)
         m._apply_upmap(pool, pg, raw)
         up = m._raw_to_up_osds(pool, raw)
         up_primary = m._pick_primary(up)
         m._apply_primary_affinity(pps, pool, up, up_primary)
         return up
 
-    def _rows_device(self, pid: int):
-        import jax.numpy as jnp
-
-        from ceph_tpu.crush.mapper_jax import RESCUE_PAD
-
-        pm = self._pool_mapper(pid)
-        n = pm.spec.pg_num
-        DV = int(pm.dev["weight"].shape[0])
-        # precompile the rescue kernel for this structure so a later
-        # steady epoch's first flagged lane cannot book the compile
-        wk = (pm.cache_key, DV)
-        if wk not in self._loop_warm:
-            pm.jitted_loop()(
-                jnp.zeros(RESCUE_PAD, jnp.uint32), pm.dev, {})
-            self._loop_warm.add(wk)
-        rows = pm.map_all_device(self.scenario.chunk)
-        seeds, fix = self._overlay_fixup(pid, int(rows.shape[1]))
-        if len(seeds):
-            rows = rows.at[jnp.asarray(seeds)].set(jnp.asarray(fix))
-        skey = (pm.cache_key, int(rows.shape[0]), int(rows.shape[1]),
-                DV)
-        return rows, n, skey
+    # stats that are pure functions of the CURRENT rows — replayable
+    # without device work when the rows' version tag is unchanged
+    # (moved/remapped compare against prev rows: identical rows give 0)
+    _ROW_STATS = ("degraded", "unmapped", "at_risk", "dup")
 
     def _account_pool(self, pid: int, baseline: bool = False,
                       force_host: bool = False):
         """Map one pool and reduce the epoch stats.  Device path unless
-        the backend is "ref" or a device loss degraded this call."""
+        the backend is "ref" or a device loss degraded this call.
+
+        O(delta) steady path: when the pool's ClusterState version tag
+        matches both the previous epoch's rows and the cached row-stats
+        — nothing feeding this pool's mapping changed — the epoch books
+        NO device work at all: rows are bit-identical by the tag
+        contract, so moved/remapped are 0 and the row-pure stats replay
+        from the cache, digest-exactly."""
         pool = self.m.pools[pid]
         tol = self._pool_tolerance(pool)
-        if self.backend == "jax" and not force_host:
+        if (self.backend == "jax" and not force_host
+                and self.state is not None):
             import jax.numpy as jnp
 
-            rows, n, skey = self._rows_device(pid)
+            rows, skey, tag = self.state.rows(pid)
+            n = pool.pg_num
             prev = self._prev_rows.get(pid)
-            if prev is None or tuple(prev.shape) != tuple(rows.shape):
-                prev_dev = rows  # fresh/resized pool: self-compare
+            cached = self._stats_cache.get(pid)
+            if (not baseline and prev is not None and prev[0] == tag
+                    and cached is not None and cached[0] == tag
+                    and cached[1]["tol"] == tol):
+                st = dict(cached[1]["stats"], moved=0, remapped=0)
             else:
-                prev_dev = prev if not isinstance(prev, np.ndarray) \
-                    else jnp.asarray(prev)
-            self._prev_rows[pid] = rows  # stays device-resident
-            out = np.asarray(_stats_account()(
-                prev_dev, rows, jnp.uint32(n), jnp.int32(pool.size),
-                jnp.int32(tol),
-            ))
+                if (prev is None
+                        or tuple(prev[1].shape) != tuple(rows.shape)):
+                    prev_dev = rows  # fresh/resized pool: self-compare
+                else:
+                    prev_dev = prev[1] if not isinstance(
+                        prev[1], np.ndarray) else jnp.asarray(prev[1])
+                out = np.asarray(_stats_account()(
+                    prev_dev, rows, jnp.uint32(n), jnp.int32(pool.size),
+                    jnp.int32(tol),
+                ))
+                st = {k: int(v) for k, v in zip(STAT_KEYS, out)}
+                self._stats_cache[pid] = (tag, {
+                    "tol": tol,
+                    "stats": {k: st[k] for k in self._ROW_STATS},
+                })
+            self._prev_rows[pid] = (tag, rows)  # stays device-resident
             if baseline:  # ran for the warmup, not the books
                 return None, skey
-            st = {k: int(v) for k, v in zip(STAT_KEYS, out)}
         else:
             up, _, _, _ = _map_ref(self.m, pid)
             rows = up.astype(np.int32)
@@ -734,9 +726,10 @@ class LifetimeSim:
             prev = self._prev_rows.get(pid)
             prev_np = rows if (
                 prev is None
-                or tuple(np.shape(prev)) != tuple(rows.shape)
-            ) else np.asarray(prev)
-            self._prev_rows[pid] = rows
+                or tuple(np.shape(prev[1])) != tuple(rows.shape)
+            ) else np.asarray(prev[1])
+            self._prev_rows[pid] = (None, rows)
+            self._stats_cache.pop(pid, None)
             if baseline:
                 return None, skey
             st = dict(zip(
@@ -774,13 +767,13 @@ class LifetimeSim:
         for pid in list(self._prev_rows):
             if pid not in self.m.pools:
                 del self._prev_rows[pid]
-                self._pm_cache.pop(pid, None)
+                self._stats_cache.pop(pid, None)
         return stats, frozenset(skeys)
 
     # -- invariants --------------------------------------------------------
 
     def _row_slice(self, pid: int, seeds: np.ndarray) -> np.ndarray:
-        rows = self._prev_rows[pid]
+        rows = self._prev_rows[pid][1]
         if isinstance(rows, np.ndarray):
             return rows[seeds]
         import jax.numpy as jnp
@@ -795,7 +788,7 @@ class LifetimeSim:
             flagged = st["dup"] > 0 or (
                 st["unmapped"] > 0 and up_osds >= pool.size)
             if flagged:
-                rows = self._prev_rows[pid]
+                rows = self._prev_rows[pid][1]
                 msgs = check_rows_invariants(
                     self.m, pid, np.asarray(rows), st["n"],
                     oracle=lambda s, pid=pid: self._host_up(pid, s))
@@ -809,11 +802,33 @@ class LifetimeSim:
                 # row whose raw replay maps nothing is degradation
             else:
                 # overlay respect stays cheap: only overlay-carrying
-                # seeds are fetched (bounded sample)
-                self._check_overlays(e, pid, st["n"], rng)
-        temp_msgs = check_pg_temp_invariants(self.m)
-        if temp_msgs:
-            self._violate(e, temp_msgs)
+                # seeds are fetched (bounded sample), and a pool whose
+                # rows version tag is unchanged since its last CLEAN
+                # check is skipped outright — equal tags guarantee
+                # bit-identical rows, so re-checking cannot differ
+                tag = self._prev_rows[pid][0]
+                if tag is None or self._overlay_checked.get(pid) != tag:
+                    self._check_overlays(e, pid, st["n"], rng)
+                    if tag is not None:
+                        self._overlay_checked[pid] = tag
+        tkey = None
+        if self.state is not None:
+            # pg_temp semantics only need re-checking when an input
+            # changed: the temp/primary entries themselves or anything
+            # feeding the mapping (the state's aggregate version tag)
+            tkey = (
+                self.state.state_tag(),
+                tuple(sorted(((pg.pool, pg.seed), tuple(v))
+                             for pg, v in self.m.pg_temp.items())),
+                tuple(sorted(((pg.pool, pg.seed), v)
+                             for pg, v in self.m.primary_temp.items())),
+            )
+        if tkey is None or tkey != self._pg_temp_checked:
+            temp_msgs = check_pg_temp_invariants(self.m)
+            if temp_msgs:
+                self._violate(e, temp_msgs)
+            elif tkey is not None:
+                self._pg_temp_checked = tkey
         every = self.scenario.spotcheck_every
         if every and e % every == 0:
             self._spot_check(e, rng)
@@ -944,11 +959,11 @@ class LifetimeSim:
         kind = "balance" if balance else (force or self._draw_kind(rng))
         if kind != "balance":
             kind, detail = self._apply_kind(kind, e, rng, inc, touched)
-            apply_incremental(m, inc)
+            self._apply_inc(inc)
         else:
             if (inc.new_state or inc.new_pg_temp
                     or inc.new_primary_temp):
-                apply_incremental(m, inc)  # expiries first, own epoch
+                self._apply_inc(inc)  # expiries first, own epoch
             detail = self._balance(e)
         if kind != "quiet":
             _L.inc("events_applied")
@@ -956,6 +971,22 @@ class LifetimeSim:
         if notes:
             detail = detail + " +" + "+".join(notes)
         return detail
+
+    def _apply_inc(self, inc: Incremental) -> None:
+        """Advance the map by one epoch delta — through the
+        device-resident ClusterState (value deltas scatter on device in
+        O(delta), structural ones re-key) on the jax backend, plain
+        host application on "ref".  A genuinely structural delta marks
+        the epoch structural even when the compiled shapes happen to
+        coincide (e.g. a crush item removal that keeps every table
+        shape); a FORCED rebuild (CEPH_TPU_STATE_DELTA=0) does not —
+        that is exactly the contract break steady_full_rebuilds
+        exposes."""
+        if self.state is not None:
+            if self.state.apply(inc) == "rebuild":
+                self._structural_apply = True
+        else:
+            apply_incremental(self.m, inc)
 
     def _apply_kind(self, kind: str, e: int, rng, inc: Incremental,
                     touched: set) -> tuple[str, str]:
@@ -1114,11 +1145,12 @@ class LifetimeSim:
                     [self.scenario.seed, e, 1]),
             )
             ms = MappingState(self.m, synthetic_pg_stats(self.m),
-                              desc=f"epoch{e}", mapper=mapper)
+                              desc=f"epoch{e}", mapper=mapper,
+                              state=self.state)
             plan = bal.plan_create(f"epoch{e}", ms, mode="upmap")
             rc, _ = bal.optimize(plan)
             if rc == 0:
-                rc2, msg = bal.execute(plan, self.m)
+                rc2, msg = bal.execute(plan, self.m, state=self.state)
                 if rc2 != 0:
                     raise RuntimeError(f"balancer execute: {msg}")
                 changed = (len(plan.inc.new_pg_upmap_items)
@@ -1132,7 +1164,7 @@ class LifetimeSim:
             if not faults.looks_like_device_loss(exc):
                 raise
             self._record_fallback(e, "balancer", exc)
-        apply_incremental(self.m, Incremental(epoch=self.m.epoch + 1))
+        self._apply_inc(Incremental(epoch=self.m.epoch + 1))
         return "balance changed=0"
 
     # -- the step ----------------------------------------------------------
@@ -1153,6 +1185,8 @@ class LifetimeSim:
         rng = np.random.default_rng([self.scenario.seed, e])
         t0 = time.perf_counter()
         jit0 = obs.jit_counters()
+        rb0 = self.state.full_rebuilds if self.state is not None else 0
+        self._structural_apply = False
         with obs.span("sim.epoch", epoch=e):
             event = self._apply_event(e, rng, force_event)
             if event.startswith("balance"):
@@ -1161,16 +1195,15 @@ class LifetimeSim:
                 self._last_balance_key = bal_key
             else:
                 structural_hint = False
-            if not inc_crush_kept(event):
-                self._pm_cache.clear()
-            if event.split(" ", 1)[0] in RAW_CHANGING_EVENTS:
-                self._raw_memo.clear()
             stats, skeys = self._account_epoch(e)
             epoch_s = self._integrate(stats)
             self._invariants(e, rng, stats)
         jd = obs.jit_counters_delta(jit0)
         compiles = jd["compiles"] + jd["retraces"]
+        rebuilds = (self.state.full_rebuilds - rb0
+                    if self.state is not None else 0)
         structural = (structural_hint
+                      or self._structural_apply
                       or self._prev_skeys is None
                       or skeys != self._prev_skeys)
         self._prev_skeys = skeys
@@ -1182,10 +1215,11 @@ class LifetimeSim:
             self.steady_epochs += 1
             self.steady_compiles += compiles
             self.steady_pipe_misses += jd["pipe_cache_misses"]
-            if compiles:
+            self.steady_full_rebuilds += rebuilds
+            if compiles or rebuilds:
                 _log(1, f"epoch {e}: steady epoch booked {compiles} "
-                        f"compile(s) — trace-once contract broken "
-                        f"({event})")
+                        f"compile(s) + {rebuilds} state rebuild(s) — "
+                        f"O(delta) contract broken ({event})")
         line = (
             f"{e}|{event}|"
             + ";".join(
@@ -1283,7 +1317,12 @@ class LifetimeSim:
                 "steady_epochs": self.steady_epochs,
                 "steady_compiles": self.steady_compiles,
                 "steady_pipe_misses": self.steady_pipe_misses,
+                "steady_full_rebuilds": self.steady_full_rebuilds,
                 "total_compiles": self.total_compiles,
+            },
+            "state": None if self.state is None else {
+                "delta_applies": self.state.delta_applies,
+                "full_rebuilds": self.state.full_rebuilds,
             },
             "jit_compiles_per_epoch": round(
                 self.total_compiles / self.steps, 4
@@ -1304,22 +1343,9 @@ class LifetimeSim:
         return out
 
 
-# events after which a PG's post-descent raw mapping may differ: the
-# CRUSH tree changed (remove/expand), the descent's weight overlay
-# changed (death zeroes, reweight scales), or the pool table changed
-# (split/pool_create).  Everything else — flaps, outages, pg_temp,
-# balancer upmap entries — only changes the up/down filter or the
-# post-descent overlay application, both recomputed per epoch, so
-# `_raw_memo` survives (staleness would be caught by the spot-check
-# lanes and the overlay-respect invariant).
-RAW_CHANGING_EVENTS = frozenset(
-    ("death", "reweight", "remove", "expand", "split", "pool_create"))
-
-
-def inc_crush_kept(event: str) -> bool:
-    """True when the event left the CRUSH tree and pool table intact —
-    the compiled PoolMapper cache stays valid.  Events that ship a new
-    crush blob (remove/expand) or mutate pool structure (split /
-    pool_create) must rebuild mappers."""
-    head = event.split(" ", 1)[0]
-    return head not in ("remove", "expand", "split", "pool_create")
+# Which deltas invalidate what is no longer event-string heuristics:
+# `osd.state.classify_incremental` reads the Incremental itself —
+# value-only deltas scatter on device in O(delta) and bump the exact
+# version counters (vectors / raw descent / per-pool overlays), while
+# structural ones re-key the ClusterState.  Staleness would be caught
+# by the spot-check lanes and the overlay-respect invariant.

@@ -95,6 +95,16 @@ def test_seeded_regressions_flagged():
         "serve.steady_compiles",               # 0 -> 3
         "serve.device_loss_recovered",         # the proof bit flipped
         "serve.chaos.dropped",                 # 0 -> 4: queries dropped
+        # ClusterState O(delta) contract (v6, seeded in r09->r10):
+        # value applies falling back to rebuilds and serve swaps
+        # restaging from scratch are semantic drift, compared raw
+        "lifetime.steady_full_rebuilds",       # 0 -> 5
+        "lifetime.balancer_builds",            # 0 -> 6
+        "lifetime.state.delta_applies",        # 497 -> 3
+        "lifetime.state.full_rebuilds",        # 14 -> 180
+        "serve.swap_delta_applies",            # 9 -> 0
+        "serve.swap_full_restages",            # 0 -> 4
+        "serve.swap_state_rebuilds",           # 0 -> 9
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -108,6 +118,33 @@ def test_seeded_regressions_flagged():
     for d in rep["regressions"]:
         if d["metric"] not in structural:
             assert d["normalized"], d
+
+
+def test_state_contract_fixture_pair_v6():
+    """The v6 seeded pair in isolation: the healthy ClusterState round
+    (r09) against the O(delta)-contract regression (r10) — every state
+    metric flags raw, and the epochs/s collapse flags normalized (same
+    calibration, so it is a same-machine semantic slowdown)."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r09"], by["r10"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    for name in ("lifetime.steady_full_rebuilds",
+                 "lifetime.balancer_builds",
+                 "lifetime.state.delta_applies",
+                 "lifetime.state.full_rebuilds",
+                 "serve.swap_delta_applies",
+                 "serve.swap_full_restages",
+                 "serve.swap_state_rebuilds"):
+        assert name in flagged, name
+        assert not flagged[name]["normalized"]  # structural: raw
+    assert "lifetime.epochs_per_sec" in flagged  # 175 -> 14
+    assert flagged["lifetime.epochs_per_sec"]["normalized"]
+    # the healthy direction stays clean
+    assert diff_series([by["r08"], by["r09"]])["verdict"] != \
+        "regression" or not any(
+            d["metric"].startswith(("lifetime.state", "serve.swap_"))
+            for d in diff_series([by["r08"], by["r09"]])["regressions"])
 
 
 def test_healthy_calibrated_rounds_are_clean():

@@ -235,6 +235,26 @@ def test_counter_decl_observe_and_time(tmp_path):
     assert len(v) == 1 and v[0].line == 7
 
 
+def test_counter_decl_state_group_idiom(tmp_path):
+    # the ClusterState perf group's exact declaration pattern: u64 +
+    # quantile declares resolve, a typo'd update on either kind fires
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "_L = obs.logger_for('state')\n"
+        "_L.add_u64('delta_applies', 'value deltas applied on device')\n"
+        "_L.add_u64('device_put_bytes', 'upload accounting')\n"
+        "_L.add_quantile('apply_seconds', 'per-apply wall time')\n"
+        "_L.inc('delta_applies')\n"
+        "_L.inc('device_put_bytes', 448)\n"
+        "with _L.time('apply_seconds'):\n"
+        "    pass\n"
+        "_L.inc('delta_aplies')\n"
+        "_L.observe('apply_second', 0.1)\n"
+    ), "counter-decl")
+    assert [x.line for x in v] == [10, 11]
+    assert "'delta_aplies'" in v[0].message
+
+
 # -- env-knob ---------------------------------------------------------------
 
 def test_env_knob_fires_on_unregistered(tmp_path):
@@ -327,6 +347,20 @@ def test_span_name_checks_jitaccount_base(tmp_path):
         "g = obs.JitAccount(fn, L, 'k', span='ec.gf_matmull')\n"
     ), "span-name")
     assert len(v) == 1 and v[0].line == 3
+
+
+def test_span_name_state_spans_registered(tmp_path):
+    # the ClusterState spans are registry entries; a near-miss fires
+    v = lint(tmp_path, (
+        "from ceph_tpu import obs\n"
+        "with obs.span('state.apply', epoch=2):\n"
+        "    pass\n"
+        "with obs.span('state.raw_fixup', pool=0, seeds=4):\n"
+        "    pass\n"
+        "with obs.span('state.aply'):\n"
+        "    pass\n"
+    ), "span-name")
+    assert [x.line for x in v] == [6]
 
 
 # -- fault-point ------------------------------------------------------------

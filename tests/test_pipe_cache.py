@@ -143,12 +143,14 @@ def test_cross_pool_sharing_zero_compiles():
 def _warm_both_kernels(pm: PoolMapper):
     """Compile fast AND loop kernels at the full-pool block shape so
     later deltas isolate executable reuse (jax compiles per shape; the
-    loop kernel otherwise compiles lazily on the first rescue)."""
-    from ceph_tpu.crush.mapper_jax import RESCUE_PAD
+    loop kernel otherwise compiles lazily on the first rescue, at the
+    rescue-tier shapes)."""
+    from ceph_tpu.crush.mapper_jax import RESCUE_PADS
 
     pm.map_all()
-    ps = np.zeros(RESCUE_PAD, np.uint32)
-    pm.jitted_loop()(jnp.asarray(ps), pm.dev, {})
+    for p in RESCUE_PADS:
+        ps = np.zeros(p, np.uint32)
+        pm.jitted_loop()(jnp.asarray(ps), pm.dev, {})
 
 
 def test_same_shape_weight_change_hits_pipe_cache():

@@ -1,0 +1,368 @@
+"""ClusterState: delta-vs-rebuild classification, O(delta) on-device
+apply (counter-proven: 0 compiles, 0 full-table device_puts on a
+value-only chain), bit-identical rows vs a from-scratch build and the
+host oracle, device-resident raw fixups, and the serve fork.
+
+Tier-1 keeps ONE tiny module-scoped cluster (one compile set shared
+through _PIPE_CACHE; the budget is nearly spent — see ROADMAP); the
+at-scale variant rides the slow tier."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.incremental import Incremental, apply_incremental
+from ceph_tpu.osd.osdmap import (
+    IN_WEIGHT,
+    OSD_EXISTS,
+    OSD_UP,
+    build_hierarchical,
+)
+from ceph_tpu.osd.state import (
+    ClusterState,
+    classify_incremental,
+    value_copy_map,
+)
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+
+N_PGS = 32
+N_OSDS = 8
+
+
+def _mk_map():
+    return build_hierarchical(4, 2, n_rack=2, pool=PgPool(
+        type=PoolType.REPLICATED, size=3, crush_rule=0,
+        pg_num=N_PGS, pgp_num=N_PGS))
+
+
+def _oracle_up(m, pid, seed):
+    up, _, _, _ = m.pg_to_up_acting_osds(PgId(pid, int(seed)))
+    return up
+
+
+# ------------------------------------------------- classification (no jax)
+
+
+def _inc(m, **kw):
+    inc = Incremental(epoch=m.epoch + 1)
+    for k, v in kw.items():
+        setattr(inc, k, v)
+    return inc
+
+
+def test_classify_value_only_deltas():
+    m = _mk_map()
+    for kw in (
+        {"new_weight": {2: IN_WEIGHT // 2}},          # reweight
+        {"new_state": {1: OSD_UP}},                   # flap down/up
+        {"new_primary_affinity": {3: 0x8000}},        # affinity (first!)
+        {"new_pg_temp": {PgId(0, 4): [1, 2, 3]}},     # acting override
+        {"new_primary_temp": {PgId(0, 4): 2}},
+        {"new_pg_upmap_items": {PgId(0, 5): [(1, 6)]}},
+        {"old_pg_upmap_items": {PgId(0, 5)}},
+        {"new_flags": 0x8000},
+    ):
+        kind, info = classify_incremental(_inc(m, **kw), m)
+        assert kind == "delta", kw
+    # destroy (state XOR with EXISTS on an existing osd) is value-only
+    # but raw-changing (EXISTS feeds the descent's nonexistent filter)
+    kind, info = classify_incremental(
+        _inc(m, new_state={2: OSD_EXISTS}), m)
+    assert kind == "delta" and info["raw"]
+    # flaps change the up filter only: raw survives
+    kind, info = classify_incremental(_inc(m, new_state={2: OSD_UP}), m)
+    assert kind == "delta" and not info["raw"]
+    # upmap deltas name their pool
+    kind, info = classify_incremental(
+        _inc(m, new_pg_upmap_items={PgId(0, 5): [(1, 6)]}), m)
+    assert info["upmap_pools"] == {0}
+
+
+def test_classify_structural_deltas():
+    m = _mk_map()
+    # max_osd growth
+    assert classify_incremental(_inc(m, new_max_osd=16), m)[0] \
+        == "rebuild"
+    # pg_num split of an EXISTING pool
+    inc = Incremental(epoch=m.epoch + 1)
+    pool = inc.get_new_pool(0, m.pools[0])
+    pool.pg_num *= 2
+    assert classify_incremental(inc, m)[0] == "rebuild"
+    # a structural crush change (tree edit)
+    import copy
+
+    from ceph_tpu.crush.codec import encode_crushmap
+
+    c2 = copy.deepcopy(m.crush)
+    c2.insert_item(8, 1.0, "osd.8", {"host": "hostX", "root": "default"})
+    assert classify_incremental(
+        _inc(m, crush=encode_crushmap(c2)), m)[0] == "rebuild"
+    # an out-of-range osd id cannot be a vector scatter
+    assert classify_incremental(
+        _inc(m, new_weight={99: IN_WEIGHT}), m)[0] == "rebuild"
+    # a brand-NEW pool is value-only (no device operand changes; its
+    # caches build lazily) — the old steady-epoch semantics
+    inc2 = Incremental(epoch=m.epoch + 1, new_pool_max=1)
+    inc2.new_pools[1] = PgPool(type=PoolType.REPLICATED, size=3,
+                               crush_rule=0, pg_num=16, pgp_num=16)
+    inc2.new_pool_names[1] = "p1"
+    assert classify_incremental(inc2, m)[0] == "delta"
+
+
+def test_classify_choose_args_value_delta():
+    """A crush blob differing ONLY in choose_args weight values is a
+    pos_weights-plane delta, not a re-key."""
+    import copy
+
+    from ceph_tpu.crush.codec import encode_crushmap
+    from ceph_tpu.mgr.module import compat_ws_to_choose_args
+
+    m = _mk_map()
+    ws = {o: 1.0 for o in range(m.max_osd)}
+    m.crush.choose_args[-1] = compat_ws_to_choose_args(m.crush, ws)
+    c2 = copy.deepcopy(m.crush)
+    ws2 = dict(ws)
+    ws2[0] = 0.5
+    c2.choose_args[-1] = compat_ws_to_choose_args(c2, ws2)
+    kind, info = classify_incremental(
+        _inc(m, crush=encode_crushmap(c2)), m)
+    assert kind == "delta" and info["pos_weights"]
+
+
+def test_value_copy_map_shares_structure():
+    m = _mk_map()
+    m.pg_temp[PgId(0, 3)] = [0, 1, 2]
+    c = value_copy_map(m)
+    assert c.crush is m.crush          # shared: value deltas replace it
+    assert c.pools[0] is m.pools[0]    # PgPool shared
+    assert c.osd_weight == m.osd_weight and \
+        c.osd_weight is not m.osd_weight
+    # a value chain on the copy leaves the original untouched
+    apply_incremental(c, _inc(m, new_weight={1: 123},
+                              new_pg_temp={PgId(0, 9): [2, 3, 4]}))
+    assert m.osd_weight[1] == IN_WEIGHT
+    assert PgId(0, 9) not in m.pg_temp
+    assert c.osd_weight[1] == 123
+
+
+# --------------------------------------------------- device state (jax)
+
+
+@pytest.fixture(scope="module")
+def st():
+    from ceph_tpu import obs  # noqa: F401  (jax warmup path)
+
+    m = _mk_map()
+    return ClusterState(m, chunk=256)
+
+
+def _state_counters():
+    from ceph_tpu import obs
+
+    return dict(obs.perf_dump().get("state") or {})
+
+
+def test_rows_match_host_oracle(st):
+    # every PG against the host oracle (a standalone PoolMapper would
+    # compile a second — unquantized — kernel variant just for this
+    # compare; the tier-1 budget is tight and the oracle subsumes it)
+    rows, skey, tag = st.rows(0)
+    got = np.asarray(rows)
+    for s in range(N_PGS):
+        row = [int(o) for o in got[s] if o >= 0]
+        assert row == _oracle_up(st.m, 0, s), s
+
+
+def test_value_chain_books_zero_compiles_and_zero_rebuilds(st):
+    """The tentpole contract: a value-only Incremental chain mutates
+    operands ON DEVICE in O(delta) — 0 compiles, 0 full rebuilds, no
+    full-table device_put — and maps bit-identically to a from-scratch
+    build."""
+    from ceph_tpu import obs
+
+    m = st.m
+    st.rows(0)  # warm
+    jit0 = obs.jit_counters()
+    c0 = _state_counters()
+    rb0 = st.full_rebuilds
+    up5 = _oracle_up(m, 0, 5)
+    to5 = next(o for o in range(m.max_osd)
+               if o not in up5 and m.is_up(o) and m.is_in(o))
+    chain = [  # built lazily: each inc's epoch follows the last apply
+        lambda: _inc(m, new_weight={2: IN_WEIGHT // 2}),
+        lambda: _inc(m, new_state={1: OSD_UP}),            # down
+        lambda: _inc(m, new_primary_affinity={3: 0x4000}),  # first table!
+        lambda: _inc(m, new_pg_upmap_items={
+            PgId(0, 5): [(up5[0], to5)]}),
+        lambda: _inc(m, new_pg_temp={PgId(0, 8):
+                                     _oracle_up(m, 0, 8)[::-1]}),
+        lambda: _inc(m, new_state={1: OSD_UP}),            # revive
+    ]
+    for mk in chain:
+        assert st.apply(mk()) == "delta"
+        st.rows(0)
+    jd = obs.jit_counters_delta(jit0)
+    c1 = _state_counters()
+    assert jd["compiles"] == 0 and jd["retraces"] == 0, jd
+    assert st.full_rebuilds == rb0
+    assert c1["delta_applies"] - c0["delta_applies"] == len(chain)
+    assert c1["full_rebuilds"] == c0["full_rebuilds"]
+    # O(delta) upload: each apply moves one padded scatter block of
+    # operands (32 lanes x 14 bytes), never a full table
+    assert (c1["device_put_bytes"] - c0["device_put_bytes"]
+            <= len(chain) * 600)
+
+    # bit-identical to a from-scratch build of the same map (which
+    # itself rides _PIPE_CACHE: same structure, zero compiles)
+    rows, _, _ = st.rows(0)
+    fresh = ClusterState(m, chunk=256)
+    rows2, _, _ = fresh.rows(0)
+    assert np.array_equal(np.asarray(rows), np.asarray(rows2))
+    for s in (0, 5, 8, 17):
+        got = [int(o) for o in np.asarray(rows)[s] if o >= 0]
+        assert got == _oracle_up(m, 0, s), s
+
+
+def test_version_tags_skip_unchanged_pools(st):
+    c0 = _state_counters()
+    r1, _, t1 = st.rows(0)
+    c1 = _state_counters()
+    assert c1["rows_served"] == c0["rows_served"] + 1
+    assert c1["rows_remapped"] == c0["rows_remapped"]
+    # a pg_temp delta leaves `up` rows untagged (acting-only)
+    assert st.apply(_inc(st.m, new_primary_temp={PgId(0, 2): -1})) \
+        == "delta"
+    r2, _, t2 = st.rows(0)
+    assert t2 == t1
+    # a weight delta invalidates: rows re-dispatch
+    assert st.apply(_inc(st.m, new_weight={4: IN_WEIGHT // 4})) \
+        == "delta"
+    _, _, t3 = st.rows(0)
+    assert t3 != t1
+
+
+def test_raw_rows_match_host_descent(st):
+    pm = st.mapper(0)
+    pm.refresh_dev()
+    seeds = np.asarray([0, 3, 9, 31])
+    raw = pm.raw_rows(seeds)
+    for i, s in enumerate(seeds):
+        want, _ = st.m._pg_to_raw_osds(st.m.pools[0], PgId(0, int(s)))
+        got = [int(o) for o in raw[i] if o != ITEM_NONE]
+        assert got == list(want), (s, got, want)
+
+
+def test_structural_split_forces_exactly_one_rekey(st):
+    rb0 = st.full_rebuilds
+    inc = Incremental(epoch=st.m.epoch + 1)
+    pool = inc.get_new_pool(0, st.m.pools[0])
+    pool.pg_num *= 2
+    pool.pgp_num = pool.pg_num
+    assert st.apply(inc) == "rebuild"
+    assert st.full_rebuilds == rb0 + 1
+    rows, _, _ = st.rows(0)
+    assert rows.shape[0] == N_PGS * 2
+    for s in (1, 40, 63):
+        got = [int(o) for o in np.asarray(rows)[s] if o >= 0]
+        assert got == _oracle_up(st.m, 0, s)
+
+
+def test_fork_is_copy_free_and_isolated(st):
+    c0 = _state_counters()
+    e0 = st.m.epoch
+    w0 = st.m.osd_weight[5]
+    f = st.fork(_inc(st.m, new_weight={5: IN_WEIGHT // 8}))
+    c1 = _state_counters()
+    assert c1["value_forks"] == c0["value_forks"] + 1
+    assert f.m.crush is st.m.crush        # structure shared, not copied
+    assert f.m.epoch == e0 + 1 and st.m.epoch == e0
+    assert st.m.osd_weight[5] == w0       # parent untouched
+    assert f.m.osd_weight[5] == IN_WEIGHT // 8
+    # parent vectors untouched (functional scatter)
+    assert f.vectors["weight"] is not st.vectors["weight"]
+    rows, _, _ = f.rows(0)
+    for s in (0, 11):
+        got = [int(o) for o in np.asarray(rows)[s] if o >= 0]
+        assert got == _oracle_up(f.m, 0, s)
+    # a structural inc refuses to fork
+    inc = Incremental(epoch=st.m.epoch + 1, new_max_osd=32)
+    with pytest.raises(ValueError, match="value-only"):
+        st.fork(inc)
+
+
+def test_destroy_revive_refreshes_raw_caches(st):
+    """Regression (review finding): a new_state XOR that sets EXISTS
+    back ON (revival of a destroyed OSD) changes the descent's
+    nonexistent-removal input exactly like the destroy did — the raw
+    version must bump BOTH ways or overlay fixups/oracle serve stale
+    descents."""
+    m = st.m
+    # ensure the pool carries an overlay entry so fixups are live
+    seeds = st._overlay_seeds(0)
+    if not seeds:
+        up = _oracle_up(m, 0, 5)
+        to = next(o for o in range(m.max_osd)
+                  if o not in up and m.is_up(o) and m.is_in(o))
+        assert st.apply(_inc(m, new_pg_upmap_items={
+            PgId(0, 5): [(up[0], to)]})) == "delta"
+    st.rows(0)
+    victim = _oracle_up(m, 0, int(st._overlay_seeds(0)[0]))[0]
+    # destroy (EXISTS clears) then revive (XOR sets EXISTS back)
+    kind, info = classify_incremental(
+        _inc(m, new_state={victim: OSD_EXISTS}), m)
+    assert kind == "delta" and info["raw"]
+    assert st.apply(_inc(m, new_state={victim: OSD_EXISTS})) == "delta"
+    st.rows(0)
+    kind, info = classify_incremental(
+        _inc(m, new_state={victim: OSD_EXISTS}), m)
+    assert info["raw"], "revival must be raw-changing too"
+    assert st.apply(_inc(m, new_state={victim: OSD_EXISTS})) == "delta"
+    # mark it up+in again and verify every row against the host oracle
+    inc = _inc(m, new_up_client={victim: b""},
+               new_weight={victim: IN_WEIGHT})
+    assert st.apply(inc) == "delta"
+    rows, _, _ = st.rows(0)
+    got = np.asarray(rows)
+    for s in range(m.pools[0].pg_num):
+        row = [int(o) for o in got[s] if o >= 0]
+        assert row == _oracle_up(m, 0, s), s
+
+
+def test_delta_knob_forces_rebuild(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_STATE_DELTA", "0")
+    st2 = ClusterState(_mk_map(), chunk=256)
+    rb0 = st2.full_rebuilds
+    assert st2.apply(_inc(st2.m, new_weight={1: 77})) \
+        == "forced_rebuild"
+    assert st2.full_rebuilds == rb0 + 1
+    assert st2.delta_applies == 0
+
+
+@pytest.mark.slow
+def test_value_chain_at_scale_zero_compiles():
+    """The at-scale variant: a bigger cluster, a longer value chain,
+    same 0-compile / 0-rebuild contract (per the 870s tier-1 budget
+    this rides the slow tier)."""
+    from ceph_tpu import obs
+
+    m = build_hierarchical(8, 4, n_rack=2, pool=PgPool(
+        type=PoolType.REPLICATED, size=3, crush_rule=0,
+        pg_num=1024, pgp_num=1024))
+    st = ClusterState(m, chunk=1024)
+    st.rows(0)
+    jit0 = obs.jit_counters()
+    rng = np.random.default_rng(7)
+    for e in range(32):
+        inc = Incremental(epoch=m.epoch + 1)
+        for o in rng.choice(m.max_osd, 3, replace=False):
+            inc.new_weight[int(o)] = int(IN_WEIGHT
+                                         * (0.5 + 0.5 * rng.random()))
+        assert st.apply(inc) == "delta"
+        rows, _, _ = st.rows(0)
+    jd = obs.jit_counters_delta(jit0)
+    assert jd["compiles"] == 0 and jd["retraces"] == 0, jd
+    for s in rng.integers(0, 1024, 8):
+        got = [int(o) for o in np.asarray(rows)[int(s)] if o >= 0]
+        assert got == _oracle_up(m, 0, int(s))

@@ -58,7 +58,16 @@ from pathlib import Path
 #     request p50/p99 calibration-normalized; dropped / steady-shed /
 #     swap-stall / steady-compile counts and the degraded-recovery
 #     proof bit structural).
-SCHEMA_VERSION = 5
+# v6: adds the ClusterState O(delta) metrics: lifetime
+#     steady_full_rebuilds / balancer_builds and the per-run `state`
+#     counters (delta_applies, full_rebuilds, device_put_bytes) under
+#     `lifetime.state`, plus serve swap_delta_applies /
+#     swap_full_restages / swap_state_rebuilds — all seeded-scenario
+#     structural counts, compared raw (an epoch apply or value swap
+#     that stops being O(delta) is semantic drift, never hardware
+#     variance).  lifetime.epochs_per_sec (already v4) is where the
+#     refactor's uplift lands, calibration-normalized as before.
+SCHEMA_VERSION = 6
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -319,6 +328,16 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         True, True)
     put("lifetime.cluster_years_per_hour",
         lf.get("cluster_years_per_hour"), True, True)
+    # ClusterState O(delta) contract (v6): seeded counts, raw compare
+    put("lifetime.steady_full_rebuilds",
+        lf.get("steady_full_rebuilds"), False, False)
+    put("lifetime.balancer_builds", lf.get("balancer_builds"),
+        False, False)
+    lst = lf.get("state") or {}
+    put("lifetime.state.delta_applies", lst.get("delta_applies"),
+        True, False)
+    put("lifetime.state.full_rebuilds", lst.get("full_rebuilds"),
+        False, False)
     # serving daemon (v5): the client-visible story.  Load and swap
     # cadence are seeded, so the never-dropped / shed / stall /
     # steady-compile counts and the recovery proof bit are semantic
@@ -334,6 +353,13 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
     put("serve.steady_compiles", sv.get("steady_compiles"),
         False, False)
     put("serve.swaps", sv.get("swaps"), True, False)
+    # v6: value-only swaps must stage via ClusterState delta forks
+    put("serve.swap_delta_applies", sv.get("swap_delta_applies"),
+        True, False)
+    put("serve.swap_full_restages", sv.get("swap_full_restages"),
+        False, False)
+    put("serve.swap_state_rebuilds", sv.get("swap_state_rebuilds"),
+        False, False)
     if isinstance(sv.get("device_loss_recovered"), bool):
         out["serve.device_loss_recovered"] = (
             float(sv["device_loss_recovered"]), True, False)
