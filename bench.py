@@ -945,7 +945,8 @@ def bench_serve(h) -> dict:
     res["chaos"] = {k: chaos.get(k) for k in (
         "epochs", "qps", "p50_s", "p99_s", "dropped", "swaps_ok",
         "swaps_rejected", "swap_stall_p99_s", "queries_shed",
-        "queries_expired", "sim_violations")}
+        "queries_expired", "sim_violations", "degraded_reads_served",
+        "at_risk_hits", "recovery_backlog_gb")}
     res["jit"] = _jit_delta(jit0)
     return res
 
@@ -954,7 +955,16 @@ DEFAULT_LIFETIME_SCENARIO = (
     "hosts=4,osds_per_host=3,racks=2,pgs=32,ec=2+1,ec_pgs=16,"
     "chunk=256,balance_every=96,balance_max=4,spotcheck_every=48,"
     "checkpoint_every=128,seed=11,p_death=0.03,p_reweight=0.05,"
-    "max_pools=3,max_pgs=64,max_expand=1,new_pool_pgs=32"
+    "max_pools=3,max_pgs=64,max_expand=1,new_pool_pgs=32,"
+    # the recovery data plane + client workload (PR 14): queue-model
+    # recovery with RapidRAID-style pipelined EC repair, and seeded
+    # client traffic so the headline is a pareto record —
+    # cluster-years/hour AT a stated served QPS.  Bandwidth/slots are
+    # scarce on purpose (one backfill stream per OSD, 25 MB/s) so an
+    # epoch's movement genuinely carries backlog across epochs — the
+    # behavior the flat model's silent floor discarded
+    "recovery=queue,pipeline_repair=1,workload=1,wl_sample=64,"
+    "max_backfills=1,recovery_mbps=25,osd_mbps=50"
 )
 
 
@@ -1053,6 +1063,18 @@ def bench_lifetime(h) -> dict:
         "jit_compiles_per_epoch": out_a["jit_compiles_per_epoch"],
         "at_risk_pg_seconds": round(
             out_a["report"]["at_risk_pg_seconds"], 3),
+        # the recovery data plane + client workload (schema v7): the
+        # pareto headline is cluster-years/hour AT the stated served
+        # QPS, with the backlog the queue model actually carried
+        "recovery": None if out_a.get("recovery") is None else dict(
+            out_a["recovery"],
+            # observed wall-clock drain rate rides inside the recovery
+            # record so the benchdiff metric path mirrors the field path
+            drain_gbps=round(
+                out_a["recovery"]["drained_gb"] / out_a["wall_s"], 3)
+            if out_a.get("wall_s") else 0.0),
+        "workload": out_a.get("workload"),
+        "pareto": out_a.get("pareto"),
         # robustness proofs
         "device_loss_fallbacks":
             out_a["provenance"]["device_loss_fallbacks"],
@@ -1578,6 +1600,13 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
             "benchdiff did not flag the ClusterState O(delta)-contract "
             "regression seeded in the fixture series (schema v6 state "
             "metrics not folded)")
+    elif not any(d["metric"].startswith(("lifetime.recovery.",
+                                         "lifetime.workload."))
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the recovery/workload regression "
+            "seeded in the fixture series (schema v7 metrics not "
+            "folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -1692,6 +1721,33 @@ def selftest() -> int:
         if not lf.get("resume_digest_match"):
             problems.append(
                 "lifetime resume digest != straight-run digest")
+        # recovery data plane + workload acceptance gates: the queue
+        # conserved every byte, a real backlog was observed (the flat
+        # model's silent floor would show 0), and the pareto headline
+        # carries a stated served QPS
+        rcv = lf.get("recovery") or {}
+        if rcv.get("conservation_violations", -1) != 0:
+            problems.append(
+                f"recovery queue conservation violations: "
+                f"{rcv.get('conservation_violations')} (enqueued != "
+                "drained + backlog somewhere)")
+        if not rcv.get("backlog_peak_gb", 0) > 0:
+            problems.append(
+                "recovery queue observed no backlog across the chaos "
+                "scenario (queue model inert — flat-floor behavior)")
+        pareto = lf.get("pareto") or {}
+        if not pareto.get("served_qps", 0) > 0:
+            problems.append(
+                "lifetime pareto headline carries no served QPS "
+                "(workload generator inert)")
+        if not pareto.get("cluster_years_per_hour", 0) > 0:
+            problems.append(
+                "lifetime pareto headline carries no "
+                "cluster-years/hour")
+        if not (lf.get("workload") or {}).get("degraded_reads", 0) > 0:
+            problems.append(
+                "lifetime workload served no degraded reads across a "
+                "chaos scenario (client-visible story missing)")
         # serve acceptance gates: sustained QPS with a recorded tail
         # across live epoch swaps, zero dropped queries, swaps that
         # never stall readers past the bound, 0 steady compiles,
@@ -1771,7 +1827,8 @@ def selftest() -> int:
                      "balancer_state_reuses", "state",
                      "device_loss_fallbacks", "resume_digest_match",
                      "epochs_per_sec", "cluster_years_per_hour",
-                     "degraded_epochs")
+                     "degraded_epochs", "recovery", "workload",
+                     "pareto")
         } or None,
         "serve": {
             k: v for k, v in (out.get("serve") or {}).items()

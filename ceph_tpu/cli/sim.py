@@ -94,6 +94,27 @@ def main(argv: list[str] | None = None) -> int:
         print(f"events          {out['events']}")
         print(f"movement        {out['report']}")
         print(f"degraded epochs {out['degraded_epochs']}")
+        rec = out.get("recovery")
+        if rec:
+            print(f"recovery        queue: {rec['enqueued_gb']} GB "
+                  f"enqueued, {rec['drained_gb']} drained, "
+                  f"{rec['backlog_gb']} backlog "
+                  f"(peak {rec['backlog_peak_gb']}), "
+                  f"{rec['completed_pgs']} PG recoveries, "
+                  f"{rec['conservation_violations']} conservation "
+                  f"violation(s)")
+        else:
+            print(f"recovery        {out['recovery_model']}")
+        wl = out.get("workload")
+        if wl:
+            print(f"workload        {wl['requests']} requests "
+                  f"({wl['served_qps']} QPS): "
+                  f"{wl['degraded_reads']} degraded reads, "
+                  f"{wl['at_risk_hits']} at-risk hits, "
+                  f"{wl['backlog_hits']} backlog hits, "
+                  f"{wl['contended_osd_epochs']} contended OSD-epochs")
+        if out.get("pareto"):
+            print(f"pareto          {out['pareto']}")
         print(f"trace-once      {out['trace_once']}")
         print(f"backend         {prov['backend']} "
               f"({prov['device_loss_fallbacks']} device-loss "

@@ -83,6 +83,13 @@ SPANS: dict[str, str] = {
     # sim/lifetime.py
     "sim.epoch": "one lifetime epoch: Incremental apply + remap + "
                  "device accounting + invariant checks",
+    "sim.recovery": "one epoch's recovery-queue drain: per-PG enqueue "
+                    "+ slot-limited priority drain against per-OSD "
+                    "capacity (scalar fetches allowed: the epoch books "
+                    "exact int64 totals)",
+    "sim.workload": "one epoch's client-workload pass: seeded request "
+                    "samples through the placement rows + contention "
+                    "accounting",
     "bench.lifetime": "lifetime bench stage body",
     # serve/ — the placement serving daemon
     "serve.batch": "one micro-batch: deadline triage + device map + "

@@ -1,0 +1,19 @@
+"""Recovery data plane: device-resident backlog/contention queue
+(`queue.py`) stepped by the lifetime simulator each epoch — per-PG
+recovery work, per-OSD bandwidth + concurrency slots, degraded-read
+priority, RapidRAID-style pipelined repair rates, and exact int64 byte
+conservation."""
+
+from ceph_tpu.recovery.queue import (
+    DRAIN_KEYS,
+    RecoveryQueue,
+    drain_pool_np,
+    stream_bytes_per_epoch,
+)
+
+__all__ = [
+    "DRAIN_KEYS",
+    "RecoveryQueue",
+    "drain_pool_np",
+    "stream_bytes_per_epoch",
+]

@@ -1,0 +1,474 @@
+"""Recovery data plane: a device-resident backlog/contention queue.
+
+The lifetime simulator (PR 10/12) modeled recovery as ONE flat division
+— `epoch_s = max(interval_s, moved_bytes / recovery_mbps)` — which has a
+silent floor: whenever the configured bandwidth drains an epoch's
+movement inside `interval_s`, the remainder is discarded, so
+`at_risk_pg_seconds` never sees a backlog, queueing, or client
+contention.  This module is the real queue the online-EC SSD-array study
+("Understanding System Characteristics of Online Erasure Coding on
+Scalable, Distributed and Large-Scale SSD Array Systems", PAPERS.md)
+describes: recovery work is *queued per PG*, drained by *per-OSD*
+resources (bandwidth + concurrent-recovery slots, the
+`osd_max_backfills` shape), degraded/at-risk PGs drain *first*
+(degraded-read priority), and unfinished work carries across epochs as
+backlog that the next epoch's clients then land on.
+
+The model, exact in int64 (bytes) and int64 (microseconds) so the jax
+kernel and the numpy mirror produce bit-identical digests:
+
+- **Enqueue.**  Each epoch, every moved-in replica lane of a PG queues
+  `shard_bytes = pg_gb·1e9 / size` of recovery work onto that PG's
+  backlog.
+- **Drain.**  An epoch lasts `interval_s` (fixed — the backlog carries,
+  nothing is discarded).  Each OSD contributes `osd_mbps·interval_s`
+  bytes of epoch capacity, shared by client traffic (subtracted first
+  when the workload generator runs) and recovery.  Recovery streams are
+  slot-limited: an OSD runs at most `max_backfills` concurrent PG
+  recoveries, each at the per-stream rate below, so an OSD's drain this
+  epoch is `min(streams · stream_bytes, capacity)`.  PGs queue on their
+  primary (first live lane); **at-risk PGs are drained first** (class
+  0), everything else shares the remaining slots/capacity (class 1);
+  within a class the OSD's allotment splits evenly (processor-sharing
+  approximation of round-robin backfill).
+- **Pipelined repair (RapidRAID).**  An EC repair stream chains
+  encode → placement → transfer.  Serially those stages sum:
+  `rate = 1 / (1/encode + 1/transfer)` (harmonic).  With
+  `pipeline_repair=1` the stages overlap the way "RapidRAID: Pipelined
+  Erasure Codes for Fast Data Archival" (PAPERS.md) chains nodes, and
+  the stream runs at the bottleneck stage: `min(encode, transfer)`.
+  The encode rate is calibrated from the measured EC strategy GB/s
+  (`ec_gbps`, default the r07 jax RS 8+4 number).
+- **Risk integration.**  `at_risk_pg_seconds` integrates the *real*
+  time each at-risk PG spends below tolerance: a PG whose backlog fully
+  drains mid-epoch contributes `backlog / share · interval_s`; one
+  still queued (or with nothing queued to fix it — down-not-out OSDs
+  CRUSH has not remapped around) contributes the whole epoch.
+- **Conservation.**  Every epoch, per pool:
+  `prev_backlog + enqueued == drained + new_backlog`, in exact int64 —
+  checked by the lifetime engine as a sim invariant (a violation means
+  the device and host disagree about bytes, which is data loss).
+
+Queue state lives in ClusterState-style device vectors (per-pool
+`backlog[n]` int64, per-OSD capacity/slot vectors), stepped by one
+jitted kernel per (rows-shape, device-vector-bound) — steady epochs
+book 0 compiles; a host-side numpy mirror (refreshed by the per-epoch
+O(n) d2h fetch that also feeds checkpoints) serves the "ref" backend
+and the device-loss degradation path bit-identically.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("sim")
+
+_L = obs.logger_for("recovery")
+_L.add_u64("enqueued_bytes",
+           "recovery bytes queued by moved-in replica lanes")
+_L.add_u64("drained_bytes",
+           "recovery bytes drained by per-OSD slot-limited streams")
+_L.add_u64("completed_pgs",
+           "PG recoveries that fully drained within an epoch")
+_L.add_u64("queued_pg_epochs",
+           "PG-epochs spent with a nonzero recovery backlog")
+_L.add_u64("fallbacks",
+           "recovery drains degraded to the host mirror after a device "
+           "loss")
+_L.add_u64("conservation_violations",
+           "epochs where prev_backlog + enqueued != drained + backlog "
+           "(also booked as a sim invariant violation)")
+_L.add_avg("backlog_bytes",
+           "end-of-epoch total recovery backlog (one observation per "
+           "epoch)")
+_L.add_avg("streams",
+           "concurrent recovery streams granted per epoch")
+_L.add_quantile("drain_seconds",
+                "wall time of one epoch's recovery drain (all pools: "
+                "dispatch + scalar fetch, or the numpy mirror)")
+
+
+def stream_bytes_per_epoch(recovery_mbps: float, t_us: int,
+                           ec_gbps: float = 0.0,
+                           pipelined: bool = False) -> int:
+    """Bytes one recovery stream moves in one epoch.  Replicated pools
+    copy at the transfer rate; EC repair chains encode->transfer —
+    serial stages sum (harmonic rate), pipelined (RapidRAID) runs at
+    the bottleneck stage."""
+    xfer = int(recovery_mbps * 1e6)
+    if ec_gbps > 0:
+        enc = int(ec_gbps * 1e9)
+        rate = min(enc, xfer) if pipelined else (
+            (enc * xfer) // (enc + xfer))
+    else:
+        rate = xfer
+    return (rate * t_us) // 1_000_000
+
+
+DRAIN_KEYS = ("enqueued", "drained", "backlog", "risk_us", "completed",
+              "queued", "streams")
+
+
+def drain_pool_np(backlog, moved, rows, cap, slots, *, shard_bytes: int,
+                  stream_bytes: int, t_us: int, n: int, size: int,
+                  tol: int):
+    """The authoritative drain formula, numpy executor (exact int64).
+    Returns (new_backlog, new_cap, new_slots, scalars dict)."""
+    rows = np.asarray(rows)
+    N, _ = rows.shape
+    DV = int(cap.shape[0])
+    backlog = np.asarray(backlog, np.int64)
+    moved = (np.zeros(N, np.int64) if moved is None
+             else np.asarray(moved, np.int64))
+    cap = np.asarray(cap, np.int64).copy()
+    slots = np.asarray(slots, np.int64).copy()
+    real = np.arange(N) < n
+    valid = (rows != ITEM_NONE) & (rows >= 0)
+    occ = valid.sum(axis=1)
+    enq = np.where(real, moved * np.int64(shard_bytes), np.int64(0))
+    b0 = backlog + enq
+    at_risk = real & (occ < size - tol)
+    queued = real & (b0 > 0)
+    first = np.argmax(valid, axis=1)
+    prim = rows[np.arange(N), first].astype(np.int64)
+    prim = np.where(valid.any(axis=1) & (prim >= 0) & (prim < DV),
+                    prim, np.int64(DV))
+    drain = np.zeros(N, np.int64)
+    share_all = np.zeros(N, np.int64)
+    streams_total = 0
+    for cls in (queued & at_risk, queued & ~at_risk):
+        n_o = np.zeros(DV + 1, np.int64)
+        np.add.at(n_o, prim, cls.astype(np.int64))
+        n_o = n_o[:DV]
+        streams = np.minimum(n_o, slots)
+        allot = np.minimum(streams * np.int64(stream_bytes), cap)
+        share_o = np.where(n_o > 0, allot // np.maximum(n_o, 1),
+                           np.int64(0))
+        share = np.where(cls, np.append(share_o, 0)[prim], np.int64(0))
+        d = np.minimum(b0, share)
+        drained_o = np.zeros(DV + 1, np.int64)
+        np.add.at(drained_o, prim, d)
+        cap = cap - drained_o[:DV]
+        slots = np.maximum(slots - streams, 0)
+        drain = drain + d
+        share_all = share_all + share
+        streams_total += int(streams.sum())
+    b_after = b0 - drain
+    completed = queued & (b_after == 0)
+    num = np.minimum(b0, share_all) * np.int64(t_us)
+    risk_t = np.where(completed & (share_all > 0),
+                      num // np.maximum(share_all, 1), np.int64(t_us))
+    risk_us = int(np.where(at_risk, risk_t, np.int64(0)).sum())
+    scalars = {
+        "enqueued": int(enq.sum()),
+        "drained": int(drain.sum()),
+        "backlog": int((b_after * real).sum()),
+        "risk_us": risk_us,
+        "completed": int(completed.sum()),
+        "queued": int(queued.sum()),
+        "streams": streams_total,
+    }
+    return b_after, cap, slots, scalars
+
+
+def _build_drain():
+    """The jitted device executor of the SAME formula (lazy jax import;
+    everything int64 — the two executors must never diverge, digest
+    equality across backends depends on it)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _drain(backlog, moved, rows, cap, slots, shard_bytes,
+               stream_bytes, t_us, n, size, tol):
+        N = rows.shape[0]
+        DV = cap.shape[0]
+        real = jnp.arange(N) < n
+        valid = (rows != ITEM_NONE) & (rows >= 0)
+        occ = jnp.sum(valid.astype(jnp.int64), axis=1)
+        enq = jnp.where(real, moved.astype(jnp.int64) * shard_bytes,
+                        jnp.int64(0))
+        b0 = backlog + enq
+        at_risk = real & (occ < size.astype(jnp.int64)
+                          - tol.astype(jnp.int64))
+        queued = real & (b0 > 0)
+        first = jnp.argmax(valid, axis=1)
+        prim = jnp.take_along_axis(
+            rows, first[:, None], axis=1)[:, 0].astype(jnp.int64)
+        prim = jnp.where(valid.any(axis=1) & (prim >= 0) & (prim < DV),
+                         prim, jnp.int64(DV))
+        drain = jnp.zeros(N, jnp.int64)
+        share_all = jnp.zeros(N, jnp.int64)
+        streams_total = jnp.int64(0)
+        for cls in (queued & at_risk, queued & ~at_risk):
+            n_o = jnp.zeros(DV + 1, jnp.int64).at[prim].add(
+                cls.astype(jnp.int64))[:DV]
+            streams = jnp.minimum(n_o, slots)
+            allot = jnp.minimum(streams * stream_bytes, cap)
+            share_o = jnp.where(n_o > 0, allot // jnp.maximum(n_o, 1),
+                                jnp.int64(0))
+            share = jnp.where(
+                cls, jnp.append(share_o, jnp.int64(0))[prim],
+                jnp.int64(0))
+            d = jnp.minimum(b0, share)
+            drained_o = jnp.zeros(DV + 1, jnp.int64).at[prim].add(d)
+            cap = cap - drained_o[:DV]
+            slots = jnp.maximum(slots - streams, 0)
+            drain = drain + d
+            share_all = share_all + share
+            streams_total = streams_total + jnp.sum(streams)
+        b_after = b0 - drain
+        completed = queued & (b_after == 0)
+        num = jnp.minimum(b0, share_all) * t_us
+        risk_t = jnp.where(completed & (share_all > 0),
+                           num // jnp.maximum(share_all, 1), t_us)
+        risk_us = jnp.sum(
+            jnp.where(at_risk, risk_t, jnp.int64(0)))
+        scalars = jnp.stack([
+            jnp.sum(enq), jnp.sum(drain),
+            jnp.sum(jnp.where(real, b_after, jnp.int64(0))),
+            risk_us,
+            jnp.sum(completed.astype(jnp.int64)),
+            jnp.sum(queued.astype(jnp.int64)),
+            streams_total,
+        ])
+        return b_after, cap, slots, scalars
+
+    return obs.JitAccount(jax.jit(_drain), _L, "drain")
+
+
+_DRAIN_ACCTS: dict[tuple, obs.JitAccount] = {}
+
+
+def _drain_account(shape_key: tuple) -> obs.JitAccount:
+    acct = _DRAIN_ACCTS.get(shape_key)
+    if acct is None:
+        acct = _DRAIN_ACCTS[shape_key] = _build_drain()
+    return acct
+
+
+class RecoveryQueue:
+    """Per-pool recovery backlogs + cumulative accounting.
+
+    Master state: the per-pool int64 backlog vectors.  On the jax
+    backend they live on device epoch-to-epoch (`_dev`); the numpy
+    mirror (`backlog`) is refreshed by each epoch's O(n) fetch and is
+    what checkpoints serialize and the degraded path drains.  The
+    engine drives the per-epoch loop (it owns the rows, the moved
+    vectors, and the fault point); this class owns the state, the
+    executors, and the totals."""
+
+    def __init__(self, *, pg_gb: float, recovery_mbps: float,
+                 interval_s: float, max_backfills: int, osd_mbps: float,
+                 pipeline_repair: int, ec_gbps: float):
+        self.pg_gb = pg_gb
+        self.recovery_mbps = recovery_mbps
+        self.t_us = int(round(interval_s * 1e6))
+        self.max_backfills = int(max_backfills)
+        self.cap_epoch_bytes = (
+            int(osd_mbps * 1e6) * self.t_us) // 1_000_000
+        self.pipeline_repair = int(pipeline_repair)
+        self.ec_gbps = ec_gbps
+        self.backlog: dict[int, np.ndarray] = {}   # pid -> int64 mirror
+        self._dev: dict[int, object] = {}          # pid -> device array
+        self.prev_total: dict[int, int] = {}
+        self.totals = {"enqueued": 0, "drained": 0, "completed": 0,
+                       "risk_us": 0, "queued_pg_epochs": 0}
+        self.backlog_peak = 0   # max END-of-epoch backlog (carried)
+        self.queue_peak = 0     # max pre-drain queue depth in an epoch
+        self._epoch_queue = 0
+        self.fallback_epochs = 0
+        self.conservation_violations = 0
+        self._warmed: set[tuple] = set()
+
+    # -- rates -------------------------------------------------------------
+
+    def shard_bytes(self, size: int) -> int:
+        return int(self.pg_gb * 1e9) // max(int(size), 1)
+
+    def stream_bytes(self, is_erasure: bool) -> int:
+        return stream_bytes_per_epoch(
+            self.recovery_mbps, self.t_us,
+            ec_gbps=self.ec_gbps if is_erasure else 0.0,
+            pipelined=bool(self.pipeline_repair))
+
+    # -- state -------------------------------------------------------------
+
+    def ensure(self, pid: int, N: int) -> np.ndarray:
+        """The pool's backlog mirror at row-count N.  A pg_num split
+        keeps the parent seeds' backlog (children start empty); any
+        resize drops the device copy (re-uploaded lazily)."""
+        b = self.backlog.get(pid)
+        if b is None or b.shape[0] != N:
+            nb = np.zeros(N, np.int64)
+            if b is not None:
+                k = min(N, b.shape[0])
+                nb[:k] = b[:k]
+                self._dev.pop(pid, None)
+            self.backlog[pid] = b = nb
+            self.prev_total.setdefault(pid, int(b.sum()))
+        return b
+
+    def drop(self, pid: int) -> None:
+        self.backlog.pop(pid, None)
+        self._dev.pop(pid, None)
+        self.prev_total.pop(pid, None)
+
+    def device_backlog(self, pid: int):
+        import jax.numpy as jnp
+
+        d = self._dev.get(pid)
+        if d is None:
+            d = self._dev[pid] = jnp.asarray(self.backlog[pid])
+        return d
+
+    def total_backlog(self) -> int:
+        return sum(int(b.sum()) for b in self.backlog.values())
+
+    # -- the drain ---------------------------------------------------------
+
+    def warm(self, pid: int, rows, cap, slots) -> None:
+        """Compile the drain kernel for this pool's shapes (baseline /
+        structural epochs) so a later steady epoch's first backlogged
+        drain cannot book a compile.  No counters, no digest effect —
+        the zero-input outputs are discarded."""
+        import jax.numpy as jnp
+
+        N = int(rows.shape[0])
+        key = (N, int(rows.shape[1]), int(cap.shape[0]))
+        if key in self._warmed:
+            return
+        _drain_account(key)(
+            jnp.zeros(N, jnp.int64), jnp.zeros(N, jnp.int64), rows,
+            cap, slots, np.int64(1), np.int64(1), np.int64(self.t_us),
+            np.uint32(N), np.int32(1), np.int32(0))
+        self._warmed.add(key)
+
+    def drain_device(self, pid: int, moved, rows, cap, slots, *,
+                     n: int, size: int, tol: int, is_erasure: bool):
+        """One pool's drain on device: backlog stays resident, the
+        mirror refreshes from the O(n) fetch, scalars come back as
+        exact ints.  Returns (new_cap, new_slots, scalars)."""
+        import jax.numpy as jnp
+
+        N = int(rows.shape[0])
+        self.ensure(pid, N)
+        key = (N, int(rows.shape[1]), int(cap.shape[0]))
+        if moved is None:
+            moved = jnp.zeros(N, jnp.int64)
+        b_after, cap, slots, scal = _drain_account(key)(
+            self.device_backlog(pid), moved.astype(jnp.int64), rows,
+            cap, slots,
+            np.int64(self.shard_bytes(size)),
+            np.int64(self.stream_bytes(is_erasure)),
+            np.int64(self.t_us), np.uint32(n), np.int32(size),
+            np.int32(tol))
+        self._dev[pid] = b_after
+        self.backlog[pid] = np.asarray(b_after)
+        scalars = dict(zip(DRAIN_KEYS, (int(v) for v in
+                                        np.asarray(scal))))
+        self._warmed.add(key)
+        return cap, slots, scalars
+
+    def drain_host(self, pid: int, moved, rows, cap, slots, *, n: int,
+                   size: int, tol: int, is_erasure: bool):
+        """The numpy executor over the host mirror (ref backend, and
+        the device-loss degradation path — bit-identical scalars)."""
+        rows = np.asarray(rows)
+        self.ensure(pid, int(rows.shape[0]))
+        if moved is not None:
+            moved = np.asarray(moved)
+        b_after, cap, slots, scalars = drain_pool_np(
+            self.backlog[pid], moved, rows, cap, slots,
+            shard_bytes=self.shard_bytes(size),
+            stream_bytes=self.stream_bytes(is_erasure),
+            t_us=self.t_us, n=n, size=size, tol=tol)
+        self.backlog[pid] = b_after
+        self._dev.pop(pid, None)
+        return cap, slots, scalars
+
+    def book(self, pid: int, scalars: dict) -> bool:
+        """Fold one pool-epoch's scalars into totals/counters and check
+        byte conservation.  Returns True when conserved."""
+        prev = self.prev_total.get(pid, 0)
+        conserved = (prev + scalars["enqueued"]
+                     == scalars["drained"] + scalars["backlog"])
+        self._epoch_queue += prev + scalars["enqueued"]
+        self.prev_total[pid] = scalars["backlog"]
+        self.totals["enqueued"] += scalars["enqueued"]
+        self.totals["drained"] += scalars["drained"]
+        self.totals["completed"] += scalars["completed"]
+        self.totals["risk_us"] += scalars["risk_us"]
+        self.totals["queued_pg_epochs"] += scalars["queued"]
+        _L.inc("enqueued_bytes", scalars["enqueued"])
+        _L.inc("drained_bytes", scalars["drained"])
+        _L.inc("completed_pgs", scalars["completed"])
+        _L.inc("queued_pg_epochs", scalars["queued"])
+        _L.observe("streams", scalars["streams"])
+        if not conserved:
+            _L.inc("conservation_violations")
+            self.conservation_violations += 1
+        return conserved
+
+    def end_epoch(self) -> int:
+        total = sum(self.prev_total.values())
+        self.backlog_peak = max(self.backlog_peak, total)
+        self.queue_peak = max(self.queue_peak, self._epoch_queue)
+        self._epoch_queue = 0
+        _L.observe("backlog_bytes", total)
+        return total
+
+    # -- checkpoint --------------------------------------------------------
+
+    def state(self) -> dict:
+        return {
+            "backlog": {
+                str(pid): base64.b64encode(
+                    np.ascontiguousarray(b).tobytes()).decode()
+                for pid, b in self.backlog.items()
+            },
+            "totals": dict(self.totals),
+            "backlog_peak": self.backlog_peak,
+            "queue_peak": self.queue_peak,
+            "fallback_epochs": self.fallback_epochs,
+            "conservation_violations": self.conservation_violations,
+        }
+
+    def restore(self, st: dict) -> None:
+        self.backlog = {
+            int(pid): np.frombuffer(
+                base64.b64decode(b64), np.int64).copy()
+            for pid, b64 in (st.get("backlog") or {}).items()
+        }
+        self._dev = {}
+        self.prev_total = {pid: int(b.sum())
+                           for pid, b in self.backlog.items()}
+        self.totals = dict(st["totals"])
+        self.backlog_peak = int(st["backlog_peak"])
+        self.queue_peak = int(st.get("queue_peak", 0))
+        self.fallback_epochs = int(st.get("fallback_epochs", 0))
+        self.conservation_violations = int(
+            st.get("conservation_violations", 0))
+
+    def summary(self) -> dict:
+        total = self.total_backlog()
+        return {
+            "model": "queue",
+            "pipelined_repair": bool(self.pipeline_repair),
+            "enqueued_gb": round(self.totals["enqueued"] / 1e9, 3),
+            "drained_gb": round(self.totals["drained"] / 1e9, 3),
+            "backlog_gb": round(total / 1e9, 3),
+            "backlog_peak_gb": round(self.backlog_peak / 1e9, 3),
+            "queue_peak_gb": round(self.queue_peak / 1e9, 3),
+            "completed_pgs": self.totals["completed"],
+            "queued_pg_epochs": self.totals["queued_pg_epochs"],
+            "at_risk_pg_seconds": round(
+                self.totals["risk_us"] / 1e6, 3),
+            "conservation_violations": self.conservation_violations,
+            "fallback_epochs": self.fallback_epochs,
+        }

@@ -81,6 +81,10 @@ FAULT_POINTS: dict[str, str] = {
                    "(qualifier: epoch number)",
     "lifetime_step": "lifetime-sim step start, before the epoch's "
                      "Incremental is built (qualifier: epoch number)",
+    "recovery_step": "lifetime-sim recovery-queue drain, before the "
+                     "epoch's backlog is touched (qualifier: epoch "
+                     "number; `lost` degrades the drain to the "
+                     "bit-identical host mirror mid-run)",
     "serve_dispatch": "placement-service micro-batch device dispatch "
                       "(qualifier: batch sequence number; `lost` "
                       "degrades the batch to the host mapper, `exit` "

@@ -40,7 +40,7 @@ DEFAULT_CHAOS_SCENARIO = (
     "hosts=4,osds_per_host=3,racks=2,pgs=64,ec=,size=3,"
     "balance_every=8,balance_max=2,spotcheck_every=0,"
     "checkpoint_every=0,seed=23,p_split=0,p_pool_create=0,"
-    "p_expand=0,p_remove=0"
+    "p_expand=0,p_remove=0,workload=1,wl_sample=64"
 )
 
 
@@ -193,5 +193,16 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
         out["sim_digest"] = sim.digest
         out["sim_violations"] = len(sim.violations)
         out["sample_digest"] = svc.sample_digest()
+        if sim.workload is not None:
+            # the simulator's client-visible story, surfaced beside the
+            # service's own tallies (serve status carries the same
+            # counters — one narrative, two reporters)
+            wl = sim.workload.summary(sim.sim_seconds)
+            out["degraded_reads_served"] = wl["degraded_reads"]
+            out["at_risk_hits"] = wl["at_risk_hits"]
+            out["backlog_hits"] = wl["backlog_hits"]
+        if sim.recovery is not None:
+            out["recovery_backlog_gb"] = \
+                sim.recovery.summary()["backlog_gb"]
     svc.close()
     return out

@@ -698,6 +698,7 @@ class PlacementService:
         d = _L.dump()
         stall = d.get("swap_stall_seconds") or {}
         req = d.get("request_seconds") or {}
+        wl = obs.perf_dump().get("workload") or {}
         out = {
             "epoch": self.epoch,
             "pools": sorted(self._active.m.pools),
@@ -717,6 +718,14 @@ class PlacementService:
             "swap_stall_p99_s": stall.get("p99"),
             "request_p50_s": req.get("p50"),
             "request_p99_s": req.get("p99"),
+            # the client-visible story the lifetime workload model
+            # tells (sim/workload.py, booked when a chaos harness runs
+            # the simulator in this process): the daemon and the
+            # simulator must agree on what clients experienced
+            "workload": {
+                "degraded_reads_served": wl.get("degraded_reads", 0),
+                "at_risk_hits": wl.get("at_risk_hits", 0),
+            },
             "config": {
                 "window_s": self.config.window_s,
                 "block": self.config.block,

@@ -166,10 +166,31 @@ class Scenario:
     flap_len: int = 4
     outage_len: int = 6
     temp_len: int = 5
-    # recovery-rate model
+    # recovery model.  "" resolves from CEPH_TPU_SIM_RECOVERY (default
+    # "queue": the per-PG backlog / per-OSD slot+bandwidth data plane of
+    # ceph_tpu.recovery; "flat" is the legacy one-division model, kept
+    # bit-identical).  spec() pins the resolved value, so a checkpoint
+    # can never be resumed under the other model.
+    recovery: str = ""
     pg_gb: float = 1.0       # data per PG (GB), spread over `size` shards
     recovery_mbps: float = 100.0
     interval_s: float = 30.0  # floor of one epoch's simulated duration
+    # queue-model resources (ignored under recovery=flat)
+    max_backfills: int = 2   # per-OSD concurrent recovery streams
+    osd_mbps: float = 125.0  # per-OSD epoch bandwidth (client + recovery)
+    pipeline_repair: int = 0  # 1 = RapidRAID-style stage overlap (EC)
+    ec_gbps: float = 1.6     # measured EC strategy GB/s (encode stage)
+    # client workload generator (0 disables; metrics + digest lines
+    # only exist when enabled)
+    workload: int = 0
+    base_qps: float = 1000.0
+    read_fraction: float = 0.75
+    zipf_a: float = 4.0      # hot-key skew exponent (higher = hotter)
+    hot_pool: float = 1.0    # Zipf rank weight across pools
+    diurnal_amp: float = 0.5
+    diurnal_period: int = 288
+    obj_kb: int = 64         # bytes per modeled object request
+    wl_sample: int = 128     # sampled requests per pool per epoch
     # growth limits
     new_pool_pgs: int = 64
     max_pools: int = 6
@@ -187,6 +208,13 @@ class Scenario:
         if self.spotcheck_every < 0:
             self.spotcheck_every = int(
                 knobs.get("CEPH_TPU_SIM_SPOTCHECK", "16"))
+        if not self.recovery:
+            self.recovery = knobs.get("CEPH_TPU_SIM_RECOVERY", "queue")
+        if self.recovery not in ("queue", "flat"):
+            raise ValueError(
+                f"recovery={self.recovery!r}: known models are 'queue' "
+                "(per-PG backlog / per-OSD slot+bandwidth drain) and "
+                "'flat' (legacy one-division)")
 
     @classmethod
     def parse(cls, spec: str | None) -> "Scenario":
@@ -274,7 +302,10 @@ def build_cluster(sc: Scenario) -> OSDMap:
 # equality across backends depends on these two never diverging.
 
 
-def _stats_np(prev, rows, n: int, size: int, tol: int) -> list[int]:
+def _stats_np(prev, rows, n: int, size: int, tol: int):
+    """Returns ([degraded, unmapped, at_risk, dup, moved, remapped],
+    per-PG moved-lane counts int64 [N]) — the second output feeds the
+    recovery queue's per-PG enqueue."""
     rows = np.asarray(rows)
     prev = np.asarray(prev)
     real = np.arange(rows.shape[0]) < n
@@ -290,12 +321,14 @@ def _stats_np(prev, rows, n: int, size: int, tol: int) -> list[int]:
         axis=(1, 2))).sum())
     mem_ab = (rows[:, :, None] == prev[:, None, :]).any(axis=2)
     moved_l = ~mem_ab & valid
-    moved = int((moved_l & real[:, None]).sum())
+    moved_rows = (moved_l & real[:, None]).sum(axis=1).astype(np.int64)
+    moved = int(moved_rows.sum())
     pvalid = (prev != ITEM_NONE) & (prev >= 0)
     mem_ba = (prev[:, :, None] == rows[:, None, :]).any(axis=2)
     changed = moved_l.any(axis=1) | (~mem_ba & pvalid).any(axis=1)
     remapped = int((real & changed).sum())
-    return [degraded, unmapped, at_risk, dup, moved, remapped]
+    return [degraded, unmapped, at_risk, dup, moved, remapped], \
+        moved_rows
 
 
 def _build_stats_account():
@@ -317,12 +350,15 @@ def _build_stats_account():
         at_risk = jnp.sum((real & (occ < size - tol)).astype(jnp.int64))
         dup = jnp.sum(
             (real & reduce.duplicate_rows(rows)).astype(jnp.int64))
-        moved = reduce.misplaced_lanes(prev, rows,
-                                       extra_mask=real[:, None])
+        moved_rows = jnp.sum(
+            (reduce.moved_in_lanes(prev, rows) & real[:, None])
+            .astype(jnp.int64), axis=1)
+        moved = jnp.sum(moved_rows)
         remapped = jnp.sum(
             (real & reduce.changed_rows(prev, rows)).astype(jnp.int64))
         return jnp.stack(
-            [degraded, unmapped, at_risk, dup, moved, remapped])
+            [degraded, unmapped, at_risk, dup, moved, remapped]), \
+            moved_rows
 
     return obs.JitAccount(jax.jit(_epoch_stats), _L, "epoch_stats")
 
@@ -339,6 +375,19 @@ def _stats_account():
 
 STAT_KEYS = ("degraded", "unmapped", "at_risk", "dup", "moved",
              "remapped")
+
+# recovery digest fields: the per-pool ints chained into the epoch line
+# when the queue model runs (exact across jax/host by construction)
+RECOVERY_DIGEST_KEYS = ("enqueued", "drained", "backlog", "risk_us",
+                        "completed")
+WORKLOAD_DIGEST_KEYS = ("requests", "reads", "degraded_reads",
+                        "at_risk_hits", "backlog_hits")
+
+
+def _recovery_counters():
+    """The `recovery` perf group (declared in ceph_tpu/recovery/queue.py
+    — only reachable here after that module was imported)."""
+    return obs.logger_for("recovery")
 
 
 # ------------------------------------------------------------- invariants
@@ -509,6 +558,39 @@ class LifetimeSim:
         self.state = None
         self._prev_rows: dict[int, tuple] = {}   # pid -> (tag, rows)
         self._stats_cache: dict[int, tuple] = {}  # pid -> (tag, row-stats)
+        self._moved: dict[int, object] = {}  # pid -> per-PG moved lanes
+        # recovery data plane + client workload (ceph_tpu.recovery /
+        # sim.workload): the queue model is the default for fresh
+        # scenarios; "flat" keeps the legacy one-division model
+        # bit-identical.  The generator is opt-in (scenario workload=1).
+        self.recovery = None
+        if scenario.recovery == "queue":
+            from ceph_tpu.recovery import RecoveryQueue
+
+            self.recovery = RecoveryQueue(
+                pg_gb=scenario.pg_gb,
+                recovery_mbps=scenario.recovery_mbps,
+                interval_s=scenario.interval_s,
+                max_backfills=scenario.max_backfills,
+                osd_mbps=scenario.osd_mbps,
+                pipeline_repair=scenario.pipeline_repair,
+                ec_gbps=scenario.ec_gbps)
+        self.workload = None
+        if scenario.workload:
+            from ceph_tpu.sim.workload import WorkloadGen
+
+            self.workload = WorkloadGen(
+                seed=scenario.seed, base_qps=scenario.base_qps,
+                read_fraction=scenario.read_fraction,
+                zipf_a=scenario.zipf_a, hot_pool=scenario.hot_pool,
+                diurnal_amp=scenario.diurnal_amp,
+                diurnal_period=scenario.diurnal_period,
+                obj_kb=scenario.obj_kb, sample=scenario.wl_sample,
+                interval_s=scenario.interval_s)
+        self._cap_rem = None  # per-OSD capacity left after clients
+        # test hook: perturb a pool-epoch's drain scalars to prove the
+        # byte-conservation invariant catches a disagreeing data plane
+        self.recovery_corrupt_hook = None
         self.steady_full_rebuilds = 0
         self._prev_skeys: frozenset | None = None
         self._last_balance_key = None
@@ -564,6 +646,10 @@ class LifetimeSim:
             "expanded": self.expanded,
             "map_b64": base64.b64encode(
                 encode_osdmap(self.m)).decode(),
+            "recovery": (None if self.recovery is None
+                         else self.recovery.state()),
+            "workload": (None if self.workload is None
+                         else self.workload.state()),
         }
 
     def _restore(self, state: dict) -> None:
@@ -598,6 +684,10 @@ class LifetimeSim:
         self.dead = list(state["dead"])
         self.host_seq = int(state["host_seq"])
         self.expanded = int(state["expanded"])
+        if self.recovery is not None and state.get("recovery"):
+            self.recovery.restore(state["recovery"])
+        if self.workload is not None and state.get("workload"):
+            self.workload.restore(state["workload"])
         self.resumed_from = self.steps
         _log(1, f"lifetime resumed at epoch {self.steps} "
                 f"(map epoch {self.m.epoch})")
@@ -637,6 +727,62 @@ class LifetimeSim:
                                              force_host=True)
             skeys.add(skey)
         self._prev_skeys = frozenset(skeys)
+        self._warm_dataplane()
+
+    def _dv(self) -> int:
+        """Per-OSD vector bound for the recovery/workload kernels: the
+        ClusterState quantum on the jax backend, the same power-of-two
+        formula on "ref".  Lanes beyond max_osd are never addressed, so
+        the bound itself does not shape the (digested) outputs."""
+        if self.state is not None:
+            return self.state.DV
+        n = max(self.m.max_osd, 1)
+        return 1 << max(int(n - 1).bit_length(), 5)
+
+    def _fresh_cap(self, device: bool):
+        """A fresh epoch's per-OSD (capacity, slots) vectors."""
+        DV = self._dv()
+        cap_bytes = (self.recovery.cap_epoch_bytes
+                     if self.recovery is not None else 0)
+        slots = (self.recovery.max_backfills
+                 if self.recovery is not None else 0)
+        if device:
+            import jax.numpy as jnp
+
+            return (jnp.full(DV, jnp.int64(cap_bytes)),
+                    jnp.full(DV, jnp.int64(slots)))
+        return (np.full(DV, cap_bytes, np.int64),
+                np.full(DV, slots, np.int64))
+
+    def _warm_dataplane(self) -> None:
+        """Compile the recovery-drain and workload-traffic kernels for
+        every current pool shape (baseline and post-resume), so steady
+        epochs dispatch warm.  New shapes appearing mid-life (pool
+        creation, splits, expansion) compile on their own epoch, which
+        the skey diff already classifies structural."""
+        if self.backend != "jax" or self.state is None:
+            return
+        if self.recovery is None and self.workload is None:
+            return
+        try:
+            cap, slots = self._fresh_cap(device=True)
+            for pid in sorted(self.m.pools):
+                ent = self._prev_rows.get(pid)
+                if ent is None or isinstance(ent[1], np.ndarray):
+                    continue
+                rows = ent[1]
+                if self.recovery is not None:
+                    self.recovery.ensure(pid, int(rows.shape[0]))
+                    self.recovery.warm(pid, rows, cap, slots)
+                if self.workload is not None:
+                    self.workload.warm(
+                        pid, rows, self.recovery.device_backlog(pid)
+                        if self.recovery is not None else None,
+                        self._dv())
+        except Exception as e:
+            if not faults.looks_like_device_loss(e):
+                raise
+            self._record_fallback(0, "dataplane-warm", e)
 
     def _pool_tolerance(self, pool: PgPool) -> int:
         """Chunks/replicas the pool can lose before data is at risk:
@@ -697,6 +843,7 @@ class LifetimeSim:
                     and cached is not None and cached[0] == tag
                     and cached[1]["tol"] == tol):
                 st = dict(cached[1]["stats"], moved=0, remapped=0)
+                self._moved[pid] = None  # tag-equal rows: nothing moved
             else:
                 if (prev is None
                         or tuple(prev[1].shape) != tuple(rows.shape)):
@@ -704,11 +851,13 @@ class LifetimeSim:
                 else:
                     prev_dev = prev[1] if not isinstance(
                         prev[1], np.ndarray) else jnp.asarray(prev[1])
-                out = np.asarray(_stats_account()(
+                out, moved_rows = _stats_account()(
                     prev_dev, rows, jnp.uint32(n), jnp.int32(pool.size),
                     jnp.int32(tol),
-                ))
+                )
+                out = np.asarray(out)
                 st = {k: int(v) for k, v in zip(STAT_KEYS, out)}
+                self._moved[pid] = moved_rows  # stays device-resident
                 self._stats_cache[pid] = (tag, {
                     "tol": tol,
                     "stats": {k: st[k] for k in self._ROW_STATS},
@@ -731,10 +880,12 @@ class LifetimeSim:
             self._prev_rows[pid] = (None, rows)
             self._stats_cache.pop(pid, None)
             if baseline:
+                self._moved[pid] = None
                 return None, skey
-            st = dict(zip(
-                STAT_KEYS, _stats_np(prev_np, rows, n, pool.size, tol)
-            ))
+            stats_list, moved_rows = _stats_np(
+                prev_np, rows, n, pool.size, tol)
+            self._moved[pid] = moved_rows
+            st = dict(zip(STAT_KEYS, stats_list))
         st["n"] = n
         st["size"] = pool.size
         st["tol"] = tol
@@ -768,6 +919,9 @@ class LifetimeSim:
             if pid not in self.m.pools:
                 del self._prev_rows[pid]
                 self._stats_cache.pop(pid, None)
+                self._moved.pop(pid, None)
+                if self.recovery is not None:
+                    self.recovery.drop(pid)
         return stats, frozenset(skeys)
 
     # -- invariants --------------------------------------------------------
@@ -1167,6 +1321,170 @@ class LifetimeSim:
         self._apply_inc(Incremental(epoch=self.m.epoch + 1))
         return "balance changed=0"
 
+    # -- recovery + workload data plane ------------------------------------
+
+    def _workload_epoch(self, e: int) -> dict:
+        """One epoch of modeled client traffic through the current
+        placement rows (sim/workload.py): per-pool request samples,
+        client-visible tallies, and the per-OSD capacity remainder the
+        recovery drain then competes for."""
+        import time as _time
+
+        wl = self.workload
+        t0 = _time.perf_counter()
+        use_device = self.backend == "jax" and self.state is not None
+        pids = sorted(self.m.pools)
+        reqs = wl.pool_requests(e, pids)
+        per_pool: dict[int, dict] = {}
+        client_total = None
+        with obs.span("sim.workload", epoch=e):
+            for pid in pids:
+                pool = self.m.pools[pid]
+                tol = self._pool_tolerance(pool)
+                rows = self._prev_rows[pid][1]
+                wq = reqs[pid] // wl.sample
+                backlog = None
+                if self.recovery is not None:
+                    self.recovery.ensure(pid, int(rows.shape[0]))
+                kw = dict(n=pool.pg_num, size=pool.size, tol=tol,
+                          DV=self._dv(), wq=wq)
+                if use_device and not isinstance(rows, np.ndarray):
+                    try:
+                        if self.recovery is not None:
+                            backlog = self.recovery.device_backlog(pid)
+                        client, scal = wl.step_pool_device(
+                            e, pid, rows, backlog, **kw)
+                    except Exception as exc:
+                        if not faults.looks_like_device_loss(exc):
+                            raise
+                        self._record_fallback(e, "workload", exc)
+                        use_device = False
+                        if client_total is not None:
+                            client_total = np.asarray(client_total)
+                if not (use_device
+                        and not isinstance(rows, np.ndarray)):
+                    if self.recovery is not None:
+                        backlog = self.recovery.backlog.get(pid)
+                    client, scal = wl.step_pool_host(
+                        e, pid, np.asarray(rows), backlog, **kw)
+                wl.book(scal)
+                per_pool[pid] = scal
+                client_total = client if client_total is None \
+                    else client_total + client
+            from ceph_tpu.sim.workload import (
+                contention_jnp,
+                contention_np,
+            )
+
+            cap_bytes = self._epoch_cap_bytes()
+            if isinstance(client_total, np.ndarray):
+                rem, throttled, contended = contention_np(
+                    client_total, cap_bytes)
+            else:
+                rem, throttled, contended = contention_jnp(
+                    client_total, cap_bytes)
+            wl.book_contention(throttled, contended)
+            self._cap_rem = rem
+        wl.observe_epoch(wl.qps(e), _time.perf_counter() - t0)
+        return {"per_pool": per_pool, "throttled": throttled,
+                "contended": contended}
+
+    def _epoch_cap_bytes(self) -> int:
+        """ONE capacity number: clients are charged against exactly the
+        bytes the recovery drain then competes for."""
+        if self.recovery is not None:
+            return self.recovery.cap_epoch_bytes
+        sc = self.scenario
+        t_us = int(round(sc.interval_s * 1e6))
+        return (int(sc.osd_mbps * 1e6) * t_us) // 1_000_000
+
+    def _recovery_epoch(self, e: int, stats: dict) -> dict:
+        """One epoch of the recovery queue (ceph_tpu.recovery): enqueue
+        from the per-PG moved lanes, slot-limited priority drain against
+        the per-OSD capacity clients left over, byte conservation
+        checked per pool.  A device loss (real, or the `recovery_step`
+        fault) degrades the rest of the epoch to the bit-identical host
+        mirror — digest unchanged."""
+        import time as _time
+
+        rq = self.recovery
+        t0 = _time.perf_counter()
+        use_device = self.backend == "jax" and self.state is not None
+        with obs.span("sim.recovery", epoch=e):
+            try:
+                faults.check("recovery_step", qual=str(e))
+            except Exception as exc:
+                if not faults.looks_like_device_loss(exc):
+                    raise
+                self._record_fallback(e, "recovery", exc)
+                rq.fallback_epochs += 1
+                _recovery_counters().inc("fallbacks")
+                use_device = False
+            cap = self._cap_rem
+            _, slots = self._fresh_cap(use_device)
+            if cap is None:
+                cap, _ = self._fresh_cap(use_device)
+            elif use_device and isinstance(cap, np.ndarray):
+                use_device = False
+            elif not use_device and not isinstance(cap, np.ndarray):
+                cap = np.asarray(cap)
+            per_pool: dict[int, dict] = {}
+            for pid in sorted(self.m.pools):
+                pool = self.m.pools[pid]
+                tol = self._pool_tolerance(pool)
+                rows = self._prev_rows[pid][1]
+                N = int(rows.shape[0])
+                rq.ensure(pid, N)
+                dev_pool = use_device and not isinstance(
+                    rows, np.ndarray)
+                warmed = (not dev_pool) or (
+                    (N, int(rows.shape[1]), self._dv()) in rq._warmed)
+                kw = dict(n=pool.pg_num, size=pool.size, tol=tol,
+                          is_erasure=pool.is_erasure())
+                if (warmed and stats[pid]["moved"] == 0
+                        and rq.prev_total.get(pid, 0) == 0):
+                    # nothing queued, nothing enqueued: the drain is
+                    # identically zero — at-risk PGs (nothing queued to
+                    # fix them) accrue the whole epoch
+                    scal = dict.fromkeys(
+                        ("enqueued", "drained", "backlog", "completed",
+                         "queued", "streams"), 0)
+                    scal["risk_us"] = stats[pid]["at_risk"] * rq.t_us
+                else:
+                    moved = self._moved.get(pid)
+                    if dev_pool:
+                        try:
+                            cap, slots, scal = rq.drain_device(
+                                pid, moved, rows, cap, slots, **kw)
+                        except Exception as exc:
+                            if not faults.looks_like_device_loss(exc):
+                                raise
+                            self._record_fallback(e, "recovery", exc)
+                            rq.fallback_epochs += 1
+                            _recovery_counters().inc("fallbacks")
+                            use_device = dev_pool = False
+                            cap = np.asarray(cap)
+                            slots = np.asarray(slots)
+                    if not dev_pool:
+                        cap, slots, scal = rq.drain_host(
+                            pid, None if moved is None
+                            else np.asarray(moved),
+                            np.asarray(rows), cap, slots, **kw)
+                if self.recovery_corrupt_hook is not None:
+                    scal = self.recovery_corrupt_hook(pid, scal) or scal
+                if not rq.book(pid, scal):
+                    self._violate(e, [
+                        f"pool {pid}: recovery byte conservation "
+                        f"broken: prev+enqueued != drained+backlog "
+                        f"({scal})"
+                    ])
+                per_pool[pid] = scal
+            total = rq.end_epoch()
+        _recovery_counters().observe(
+            "drain_seconds", _time.perf_counter() - t0)
+        self._cap_rem = None
+        return {"per_pool": per_pool, "backlog_total": total}
+
     # -- the step ----------------------------------------------------------
 
     def _overlay_presence(self) -> tuple:
@@ -1196,7 +1514,11 @@ class LifetimeSim:
             else:
                 structural_hint = False
             stats, skeys = self._account_epoch(e)
-            epoch_s = self._integrate(stats)
+            wl = (self._workload_epoch(e)
+                  if self.workload is not None else None)
+            rec = (self._recovery_epoch(e, stats)
+                   if self.recovery is not None else None)
+            epoch_s = self._integrate(stats, rec)
             self._invariants(e, rng, stats)
         jd = obs.jit_counters_delta(jit0)
         compiles = jd["compiles"] + jd["retraces"]
@@ -1228,6 +1550,21 @@ class LifetimeSim:
                 for pid in sorted(stats))
             + f"|{epoch_s:.6f}"
         )
+        # new digest segments exist ONLY when the subsystem is enabled:
+        # a flat-model, workload-off run chains the exact legacy lines
+        if rec is not None:
+            line += "|R" + ";".join(
+                "{}:{}".format(pid, ":".join(
+                    str(rec["per_pool"][pid][k])
+                    for k in RECOVERY_DIGEST_KEYS))
+                for pid in sorted(rec["per_pool"]))
+        if wl is not None:
+            line += "|W" + ";".join(
+                "{}:{}".format(pid, ":".join(
+                    str(wl["per_pool"][pid][k])
+                    for k in WORKLOAD_DIGEST_KEYS))
+                for pid in sorted(wl["per_pool"])
+            ) + f"|C{wl['throttled']}:{wl['contended']}"
         self.digest = hashlib.sha256(
             (self.digest + line).encode()).hexdigest()
         self.steps = e
@@ -1248,7 +1585,7 @@ class LifetimeSim:
             "compiles": compiles,
         }
 
-    def _integrate(self, stats: dict) -> float:
+    def _integrate(self, stats: dict, rec: dict | None = None) -> float:
         sc = self.scenario
         moved_bytes = 0.0
         totals = {k: 0 for k in STAT_KEYS}
@@ -1258,8 +1595,19 @@ class LifetimeSim:
                 totals[k] += st[k]
             total_pgs += st["n"]
             moved_bytes += st["moved"] * (sc.pg_gb / st["size"]) * 1e9
-        epoch_s = max(sc.interval_s,
-                      moved_bytes / (sc.recovery_mbps * 1e6))
+        if rec is None:
+            # legacy flat model (recovery=flat): one division, silently
+            # floored at interval_s — bit-identical to PR 10's formula
+            epoch_s = max(sc.interval_s,
+                          moved_bytes / (sc.recovery_mbps * 1e6))
+            at_risk_s = totals["at_risk"] * epoch_s
+        else:
+            # queue model: epochs are fixed control-plane intervals,
+            # unfinished work carries as backlog, and the risk window
+            # is the drain kernel's per-PG completion-time integral
+            epoch_s = sc.interval_s
+            at_risk_s = sum(
+                p["risk_us"] for p in rec["per_pool"].values()) / 1e6
         self.sim_seconds += epoch_s
         self._sim_this_proc += epoch_s
         rep = MovementReport(
@@ -1268,7 +1616,7 @@ class LifetimeSim:
             replicas_moved=totals["moved"],
             degraded_pgs=totals["degraded"],
             pgs_at_risk=totals["at_risk"],
-            at_risk_pg_seconds=totals["at_risk"] * epoch_s,
+            at_risk_pg_seconds=at_risk_s,
         )
         self.report.merge(rep)
         _L.observe("at_risk_pg_seconds", rep.at_risk_pg_seconds)
@@ -1337,7 +1685,21 @@ class LifetimeSim:
                 (self._sim_this_proc / (86400.0 * 365.0))
                 / (wall / 3600.0), 3
             ) if wall else 0.0,
+            "recovery_model": self.scenario.recovery,
+            "recovery": (None if self.recovery is None
+                         else self.recovery.summary()),
+            "workload": (None if self.workload is None
+                         else self.workload.summary(self.sim_seconds)),
         }
+        if self.workload is not None:
+            # the pareto headline: simulated coverage rate AT a stated
+            # client service level (with the recovery backlog the queue
+            # model carried between them)
+            out["pareto"] = {
+                "cluster_years_per_hour":
+                    out["cluster_years_per_hour"],
+                "served_qps": out["workload"]["served_qps"],
+            }
         if self.resumed_from is not None:
             out["resumed_from"] = self.resumed_from
         return out

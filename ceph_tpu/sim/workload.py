@@ -1,0 +1,337 @@
+"""Seeded client workload generator for the lifetime simulator.
+
+Real clusters fail *under load* (ROADMAP item 5): control-plane churn
+alone never shows degraded reads, requests landing on at-risk PGs, or
+recovery-vs-client bandwidth contention — the behaviors the online-EC
+SSD-array study (PAPERS.md) calls out.  This module models client
+traffic whose object→PG→OSD path rides the SAME device-resident
+placement rows the accounting pass already produced (ClusterState /
+trace-once pipeline — no second mapping dispatch):
+
+- **QPS curve.**  Epoch `e` serves `base_qps · diurnal(e)` requests per
+  simulated second, where `diurnal` is a piecewise-linear (triangle)
+  day curve of amplitude `diurnal_amp` and period `diurnal_period`
+  epochs — exact float arithmetic, so both backends compute the same
+  request count.
+- **Skew.**  Requests split across pools by a Zipf-like rank weight
+  (`(rank+1)^-hot_pool`, hottest pool first) and across PGs inside a
+  pool by a power-law hot-key draw (`pg = floor(n · u^zipf_a)`), both
+  from `default_rng([seed, epoch, pid, 0x77])` — per-epoch streams, no
+  RNG state spans epochs, so the trajectory is resume-exact.
+- **Mapping.**  A fixed-size sample (`wl_sample` draws, each standing
+  for `requests // sample` real requests) gathers the pool's device
+  rows ON DEVICE: reads hit the primary (first live lane), writes hit
+  every live replica lane, and the per-OSD client byte histogram, the
+  degraded-read / at-risk-hit / backlog-hit tallies all reduce in the
+  same kernel.  All int64 — the numpy mirror is bit-identical, which
+  is what keeps the trajectory digest equal across jax and ref.
+- **Contention.**  Per-OSD client bytes are charged against the same
+  `osd_mbps · interval_s` epoch capacity the recovery queue drains
+  from: clients take their share first, recovery gets the remainder —
+  `throttled_bytes` (client demand beyond capacity) and
+  `contended_osd_epochs` (OSDs whose full epoch capacity went to
+  clients) are the contention record.
+
+Client-visible metrics land in the `workload` perf group and the
+per-epoch digest line (when the generator is enabled), giving the
+lifetime bench its pareto headline: cluster-years/hour *at* a stated
+served QPS.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("sim")
+
+_L = obs.logger_for("workload")
+_L.add_u64("requests",
+           "modeled client requests mapped through the placement rows")
+_L.add_u64("reads", "read requests (primary lane)")
+_L.add_u64("writes", "write requests (all live replica lanes)")
+_L.add_u64("degraded_reads",
+           "reads served degraded: up set below pool size with >=1 "
+           "live replica")
+_L.add_u64("at_risk_hits",
+           "requests that landed on at-risk PGs (below tolerance)")
+_L.add_u64("backlog_hits",
+           "requests that landed on PGs carrying recovery backlog")
+_L.add_u64("unserved",
+           "requests whose PG had no live replica at all")
+_L.add_u64("throttled_bytes",
+           "client bytes beyond the per-OSD epoch capacity")
+_L.add_u64("contended_osd_epochs",
+           "OSD-epochs whose full bandwidth capacity was consumed by "
+           "client traffic (recovery starved)")
+_L.add_avg("qps", "modeled client QPS (one observation per epoch)")
+_L.add_quantile("step_seconds",
+                "wall time of one epoch's workload pass (all pools: "
+                "draws + dispatch + scalar fetch, or the numpy mirror)")
+
+WL_KEYS = ("requests", "reads", "writes", "degraded_reads",
+           "at_risk_hits", "backlog_hits", "unserved")
+
+
+def workload_pool_np(rows, backlog, seeds, read, *, wq: int,
+                     obj_bytes: int, DV: int, size: int, tol: int):
+    """The authoritative per-pool traffic formula, numpy executor
+    (exact int64).  Returns (client_bytes[DV], scalars dict)."""
+    rows = np.asarray(rows)
+    seeds = np.asarray(seeds, np.int64)
+    read = np.asarray(read, bool)
+    backlog = (np.zeros(rows.shape[0], np.int64) if backlog is None
+               else np.asarray(backlog, np.int64))
+    r = rows[seeds]
+    valid = (r != ITEM_NONE) & (r >= 0)
+    occ = valid.sum(axis=1)
+    degraded = occ < size
+    at_risk = occ < size - tol
+    unserved = occ == 0
+    degraded_read = read & degraded & (occ > 0)
+    backlog_hit = backlog[seeds] > 0
+    first = np.argmax(valid, axis=1)
+    prim = r[np.arange(r.shape[0]), first].astype(np.int64)
+    prim = np.where(valid.any(axis=1) & (prim >= 0) & (prim < DV),
+                    prim, np.int64(DV))
+    hist = np.zeros(DV + 1, np.int64)
+    np.add.at(hist, np.where(read, prim, np.int64(DV)), 1)
+    wl = valid & (r >= 0) & (r < DV) & ~read[:, None]
+    np.add.at(hist, np.where(wl, r, DV).reshape(-1).astype(np.int64),
+              wl.reshape(-1).astype(np.int64))
+    # read lanes that fell in the DV drop bucket (no primary) were
+    # counted there; slice it off
+    client = hist[:DV] * np.int64(obj_bytes) * np.int64(wq)
+    S = int(seeds.shape[0])
+    scalars = {
+        "requests": S * wq,
+        "reads": int(read.sum()) * wq,
+        "writes": int((~read).sum()) * wq,
+        "degraded_reads": int(degraded_read.sum()) * wq,
+        "at_risk_hits": int(at_risk.sum()) * wq,
+        "backlog_hits": int(backlog_hit.sum()) * wq,
+        "unserved": int(unserved.sum()) * wq,
+    }
+    return client, scalars
+
+
+def _build_wl():
+    """The jitted device executor of the SAME formula (lazy jax
+    import; int64 end to end — bit-identical to workload_pool_np)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _wl(rows, backlog, seeds, read, wq, obj_bytes, DV, size, tol):
+        dv = int(DV)  # static: shapes derive from it
+        r = rows[seeds]
+        valid = (r != ITEM_NONE) & (r >= 0)
+        occ = jnp.sum(valid.astype(jnp.int64), axis=1)
+        size = size.astype(jnp.int64)
+        tol = tol.astype(jnp.int64)
+        degraded = occ < size
+        at_risk = occ < size - tol
+        unserved = occ == 0
+        degraded_read = read & degraded & (occ > 0)
+        backlog_hit = backlog[seeds] > 0
+        first = jnp.argmax(valid, axis=1)
+        prim = jnp.take_along_axis(
+            r, first[:, None], axis=1)[:, 0].astype(jnp.int64)
+        prim = jnp.where(valid.any(axis=1) & (prim >= 0) & (prim < dv),
+                         prim, jnp.int64(dv))
+        hist = jnp.zeros(dv + 1, jnp.int64)
+        hist = hist.at[jnp.where(read, prim, jnp.int64(dv))].add(1)
+        wl = valid & (r >= 0) & (r < dv) & ~read[:, None]
+        hist = hist.at[
+            jnp.where(wl, r, dv).reshape(-1).astype(jnp.int64)
+        ].add(wl.reshape(-1).astype(jnp.int64))
+        client = hist[:dv] * obj_bytes * wq
+        scalars = jnp.stack([
+            jnp.int64(seeds.shape[0]) * wq,
+            jnp.sum(read.astype(jnp.int64)) * wq,
+            jnp.sum((~read).astype(jnp.int64)) * wq,
+            jnp.sum(degraded_read.astype(jnp.int64)) * wq,
+            jnp.sum(at_risk.astype(jnp.int64)) * wq,
+            jnp.sum(backlog_hit.astype(jnp.int64)) * wq,
+            jnp.sum(unserved.astype(jnp.int64)) * wq,
+        ])
+        return client, scalars
+
+    return obs.JitAccount(
+        jax.jit(_wl, static_argnums=(6,)), _L, "traffic")
+
+
+_WL_ACCTS: dict[tuple, obs.JitAccount] = {}
+
+
+def _wl_account(shape_key: tuple) -> obs.JitAccount:
+    acct = _WL_ACCTS.get(shape_key)
+    if acct is None:
+        acct = _WL_ACCTS[shape_key] = _build_wl()
+    return acct
+
+
+def contention_np(client_total: np.ndarray, cap_bytes: int):
+    """Charge client bytes against the per-OSD epoch capacity: returns
+    (cap_remaining[DV], throttled_bytes, contended_osds) — exact
+    int64, the numpy executor."""
+    client_total = np.asarray(client_total, np.int64)
+    cap0 = np.full(client_total.shape[0], np.int64(cap_bytes), np.int64)
+    rem = np.maximum(cap0 - client_total, 0)
+    throttled = int(np.maximum(client_total - cap0, 0).sum())
+    contended = int(((rem == 0) & (client_total > 0)).sum())
+    return rem, throttled, contended
+
+
+def contention_jnp(client_total, cap_bytes: int):
+    """Device twin of contention_np (elementwise int64; the two scalar
+    fetches are the only host syncs)."""
+    import jax.numpy as jnp
+
+    cap0 = jnp.full(client_total.shape[0], jnp.int64(cap_bytes))
+    rem = jnp.maximum(cap0 - client_total, 0)
+    throttled = int(jnp.sum(jnp.maximum(client_total - cap0, 0)))
+    contended = int(jnp.sum(((rem == 0) & (client_total > 0))
+                            .astype(jnp.int64)))
+    return rem, throttled, contended
+
+
+class WorkloadGen:
+    """Seeded client traffic model (module docstring).  The engine
+    drives the per-epoch loop; this class owns the draws, the
+    executors, and the cumulative tallies."""
+
+    def __init__(self, *, seed: int, base_qps: float,
+                 read_fraction: float, zipf_a: float, hot_pool: float,
+                 diurnal_amp: float, diurnal_period: int,
+                 obj_kb: int, sample: int, interval_s: float):
+        self.seed = seed
+        self.base_qps = base_qps
+        self.read_fraction = read_fraction
+        self.zipf_a = zipf_a
+        self.hot_pool = hot_pool
+        self.diurnal_amp = diurnal_amp
+        self.diurnal_period = max(int(diurnal_period), 1)
+        self.obj_bytes = int(obj_kb) * 1024
+        self.sample = int(sample)
+        self.interval_s = interval_s
+        self.totals = {k: 0 for k in WL_KEYS}
+        self.totals["throttled_bytes"] = 0
+        self.totals["contended_osd_epochs"] = 0
+        self._warmed: set[tuple] = set()
+
+    # -- draws -------------------------------------------------------------
+
+    def qps(self, e: int) -> float:
+        """Piecewise-linear diurnal curve (exact float arithmetic)."""
+        phase = (e % self.diurnal_period) / self.diurnal_period
+        tri = 1.0 - 2.0 * abs(2.0 * phase - 1.0)  # [-1, 1] triangle
+        return self.base_qps * (1.0 + self.diurnal_amp * tri)
+
+    def epoch_requests(self, e: int) -> int:
+        return int(self.qps(e) * self.interval_s)
+
+    def pool_requests(self, e: int, pids: list[int]) -> dict[int, int]:
+        """Zipf-rank split of the epoch's requests across pools (pool
+        rank = position in sorted pid order: oldest pool hottest)."""
+        R = self.epoch_requests(e)
+        w = [(i + 1) ** -self.hot_pool for i in range(len(pids))]
+        tot = sum(w)
+        return {pid: int(R * wi / tot) for pid, wi in zip(pids, w)}
+
+    def draws(self, e: int, pid: int, n: int):
+        """The epoch's seeded sample for one pool: hot-key power-law
+        PG seeds + the read/write mix."""
+        rng = np.random.default_rng([self.seed, e, pid, 0x77])
+        u = rng.random(self.sample)
+        seeds = np.minimum(
+            (n * np.power(u, self.zipf_a)).astype(np.int64), n - 1)
+        read = rng.random(self.sample) < self.read_fraction
+        return seeds, read
+
+    # -- executors ---------------------------------------------------------
+
+    def warm(self, pid: int, rows, backlog, DV: int) -> None:
+        """Compile the traffic kernel for this pool's shapes (baseline /
+        structural epochs); outputs discarded, nothing booked."""
+        import jax.numpy as jnp
+
+        key = (int(rows.shape[0]), int(rows.shape[1]), DV, self.sample)
+        if key in self._warmed:
+            return
+        if backlog is None:
+            backlog = jnp.zeros(int(rows.shape[0]), jnp.int64)
+        _wl_account(key)(
+            rows, backlog, jnp.zeros(self.sample, jnp.int64),
+            jnp.zeros(self.sample, bool), np.int64(0),
+            np.int64(self.obj_bytes), DV, np.int32(1), np.int32(0))
+        self._warmed.add(key)
+
+    def step_pool_device(self, e: int, pid: int, rows, backlog, *,
+                         n: int, size: int, tol: int, DV: int,
+                         wq: int):
+        import jax.numpy as jnp
+
+        seeds, read = self.draws(e, pid, n)
+        key = (int(rows.shape[0]), int(rows.shape[1]), DV, self.sample)
+        if backlog is None:
+            backlog = jnp.zeros(int(rows.shape[0]), jnp.int64)
+        client, scal = _wl_account(key)(
+            rows, backlog, jnp.asarray(seeds), jnp.asarray(read),
+            np.int64(wq), np.int64(self.obj_bytes), DV, np.int32(size),
+            np.int32(tol))
+        self._warmed.add(key)
+        scalars = dict(zip(WL_KEYS, (int(v) for v in np.asarray(scal))))
+        return client, scalars
+
+    def step_pool_host(self, e: int, pid: int, rows, backlog, *,
+                       n: int, size: int, tol: int, DV: int, wq: int):
+        seeds, read = self.draws(e, pid, n)
+        return workload_pool_np(
+            np.asarray(rows),
+            None if backlog is None else np.asarray(backlog),
+            seeds, read, wq=wq, obj_bytes=self.obj_bytes, DV=DV,
+            size=size, tol=tol)
+
+    # -- accounting --------------------------------------------------------
+
+    def book(self, scalars: dict) -> None:
+        for k in WL_KEYS:
+            self.totals[k] += scalars[k]
+            _L.inc(k, scalars[k])
+
+    def book_contention(self, throttled: int, contended: int) -> None:
+        self.totals["throttled_bytes"] += throttled
+        self.totals["contended_osd_epochs"] += contended
+        _L.inc("throttled_bytes", throttled)
+        _L.inc("contended_osd_epochs", contended)
+
+    def observe_epoch(self, qps: float, wall_s: float) -> None:
+        _L.observe("qps", qps)
+        _L.observe("step_seconds", wall_s)
+
+    def state(self) -> dict:
+        return {"totals": dict(self.totals)}
+
+    def restore(self, st: dict) -> None:
+        self.totals = dict(st["totals"])
+
+    def summary(self, sim_seconds: float) -> dict:
+        out = {
+            "requests": self.totals["requests"],
+            "served_qps": round(
+                self.totals["requests"] / sim_seconds, 1
+            ) if sim_seconds else 0.0,
+            "reads": self.totals["reads"],
+            "writes": self.totals["writes"],
+            "degraded_reads": self.totals["degraded_reads"],
+            "at_risk_hits": self.totals["at_risk_hits"],
+            "backlog_hits": self.totals["backlog_hits"],
+            "unserved": self.totals["unserved"],
+            "throttled_gb": round(
+                self.totals["throttled_bytes"] / 1e9, 3),
+            "contended_osd_epochs": self.totals["contended_osd_epochs"],
+        }
+        return out

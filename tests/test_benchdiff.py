@@ -105,6 +105,9 @@ def test_seeded_regressions_flagged():
         "serve.swap_delta_applies",            # 9 -> 0
         "serve.swap_full_restages",            # 0 -> 4
         "serve.swap_state_rebuilds",           # 0 -> 9
+        # recovery data plane (v7, seeded in r11->r12): a queue losing
+        # bytes is device/host disagreement — semantic, compared raw
+        "lifetime.recovery.conservation_violations",  # 0 -> 3
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -112,6 +115,8 @@ def test_seeded_regressions_flagged():
         "quantiles.pipeline.map_block.p99",    # tail x4
         "serve.qps",                           # serving rate -71%
         "serve.request_p99_s",                 # serving tail x7.5
+        "lifetime.workload.served_qps",        # pareto service -32%
+        "lifetime.recovery.drain_gbps",        # drain rate -45%
     } <= flagged
     # every flagged throughput/tail metric compared on the same-machine
     # calibration basis, not raw cross-container numbers
@@ -145,6 +150,32 @@ def test_state_contract_fixture_pair_v6():
         "regression" or not any(
             d["metric"].startswith(("lifetime.state", "serve.swap_"))
             for d in diff_series([by["r08"], by["r09"]])["regressions"])
+
+
+def test_recovery_workload_fixture_pair_v7():
+    """The v7 seeded pair in isolation: the healthy recovery/workload
+    round (r11) against the regression (r12) — conservation violations
+    flag raw (byte loss is device/host disagreement, never hardware),
+    the pareto service level and drain rate flag normalized (same
+    calibration: a same-machine semantic slowdown)."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r11"], by["r12"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    assert "lifetime.recovery.conservation_violations" in flagged
+    assert not flagged["lifetime.recovery.conservation_violations"][
+        "normalized"]
+    assert "lifetime.workload.served_qps" in flagged
+    assert flagged["lifetime.workload.served_qps"]["normalized"]
+    assert "lifetime.recovery.drain_gbps" in flagged
+    assert flagged["lifetime.recovery.drain_gbps"]["normalized"]
+    # the healthy direction (r10 regression recovering into r11) never
+    # flags a recovery/workload metric
+    rep2 = diff_series([by["r10"], by["r11"]])
+    assert not any(
+        d["metric"].startswith(("lifetime.recovery.",
+                                "lifetime.workload."))
+        for d in rep2["regressions"])
 
 
 def test_healthy_calibrated_rounds_are_clean():

@@ -465,7 +465,8 @@ def test_fault_registry_covers_compiled_in_points():
 
     assert set(faults.FAULT_POINTS) == {
         "init", "map_batch", "stage", "stage_end",
-        "epoch_apply", "lifetime_step", "serve_dispatch", "epoch_swap",
+        "epoch_apply", "lifetime_step", "recovery_step",
+        "serve_dispatch", "epoch_swap",
     }
 
 

@@ -1,0 +1,364 @@
+"""Recovery data plane + client workload generator
+(ceph_tpu.recovery.queue, ceph_tpu.sim.workload, lifetime wiring).
+
+Tier-1 keeps everything on the host ("ref") backend and hand-sized
+inputs — the numpy executors ARE the authoritative formulas, and the
+device path's bit-exactness is already proven in tier-1 by the TINY
+jax==ref digest test in test_lifetime.py (which now runs the queue
+model).  The direct jnp-vs-numpy kernel comparison and the at-scale
+queue+workload jax run ride the slow tier (tier-1 budget is nearly
+spent).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.recovery import (
+    RecoveryQueue,
+    drain_pool_np,
+    stream_bytes_per_epoch,
+)
+from ceph_tpu.runtime import faults
+from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+from ceph_tpu.sim.workload import workload_pool_np
+
+TINY_WL = ("epochs=8,seed=5,hosts=6,osds_per_host=2,racks=2,pgs=32,"
+           "ec=2+2,ec_pgs=16,chunk=256,balance_every=4,"
+           "spotcheck_every=0,checkpoint_every=0,workload=1")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm_all()
+
+
+# ------------------------------------------------------------ drain model
+
+
+def test_stream_rate_pipelined_vs_serial():
+    """EC repair chains encode->transfer: serial stages sum (harmonic
+    rate), RapidRAID-style pipelining runs at the bottleneck stage —
+    strictly faster whenever both stages are finite."""
+    t_us = 30_000_000  # 30s epoch
+    xfer_only = stream_bytes_per_epoch(100.0, t_us)
+    assert xfer_only == 100_000_000 * 30
+    serial = stream_bytes_per_epoch(100.0, t_us, ec_gbps=1.6)
+    pipelined = stream_bytes_per_epoch(100.0, t_us, ec_gbps=1.6,
+                                       pipelined=True)
+    # serial = enc*xfer/(enc+xfer) < min(enc, xfer) = pipelined
+    assert serial < pipelined <= xfer_only
+    assert pipelined == xfer_only  # transfer is the bottleneck here
+
+
+def test_drain_hand_computed_two_osds():
+    """The hand-computable 2-OSD case: one PG with 5 GB of backlog on
+    osd.0, one clean PG on osd.1.  One stream at 1 GB/epoch, ample
+    capacity: exactly 1 GB drains, 4 GB carries, conservation holds."""
+    rows = np.array([[0, 1], [1, 0]], np.int32)
+    backlog = np.array([5_000_000_000, 0], np.int64)
+    cap = np.full(4, 10_000_000_000, np.int64)
+    slots = np.full(4, 1, np.int64)
+    b, cap2, slots2, s = drain_pool_np(
+        backlog, None, rows, cap, slots, shard_bytes=1,
+        stream_bytes=1_000_000_000, t_us=30_000_000, n=2, size=2,
+        tol=1)
+    assert b.tolist() == [4_000_000_000, 0]
+    assert s["enqueued"] == 0
+    assert s["drained"] == 1_000_000_000
+    assert s["backlog"] == 4_000_000_000
+    assert s["queued"] == 1 and s["completed"] == 0 and s["streams"] == 1
+    assert int(cap2[0]) == 9_000_000_000  # osd.0 paid the drain
+    assert int(slots2[0]) == 0 and int(slots2[1]) == 1
+    # conservation: prev + enqueued == drained + backlog
+    assert 5_000_000_000 + s["enqueued"] == s["drained"] + s["backlog"]
+
+    # enqueue path: 2 moved lanes on PG 1 queue 2*shard_bytes
+    b2, _, _, s2 = drain_pool_np(
+        np.zeros(2, np.int64), np.array([0, 2], np.int64), rows,
+        cap.copy(), slots.copy(), shard_bytes=500_000_000,
+        stream_bytes=1_000_000_000, t_us=30_000_000, n=2, size=2,
+        tol=1)
+    assert s2["enqueued"] == 1_000_000_000
+    # fully drained within the epoch: completion counted
+    assert s2["drained"] == 1_000_000_000 and s2["completed"] == 1
+    assert b2.tolist() == [0, 0]
+
+
+def test_drain_at_risk_priority_and_slot_limit():
+    """Two PGs queue on the same OSD with ONE slot: the at-risk PG
+    (class 0) takes the slot and the whole allotment; the healthy PG
+    waits.  The at-risk PG's completion mid-epoch books a partial risk
+    window (backlog/share of the epoch)."""
+    # PG 0 at risk (only 1 of 3 lanes alive, tol 1), PG 1 healthy
+    rows = np.array([[0, -1, -1], [0, 1, 2]], np.int32)
+    backlog = np.array([1_000_000_000, 2_000_000_000], np.int64)
+    cap = np.full(4, 10_000_000_000, np.int64)
+    slots = np.full(4, 1, np.int64)
+    t_us = 30_000_000
+    b, _, _, s = drain_pool_np(
+        backlog, None, rows, cap, slots, shard_bytes=1,
+        stream_bytes=4_000_000_000, t_us=t_us, n=2, size=3, tol=1)
+    assert b.tolist() == [0, 2_000_000_000]  # at-risk drained first
+    assert s["completed"] == 1 and s["streams"] == 1
+    # risk window: 1 GB / 4 GB-per-epoch share -> a quarter epoch
+    assert s["risk_us"] == (1_000_000_000 * t_us) // 4_000_000_000
+
+
+def test_drain_at_risk_without_backlog_accrues_whole_epoch():
+    """An at-risk PG with nothing queued (down-not-out OSDs CRUSH has
+    not remapped around) stays at risk the whole epoch."""
+    rows = np.array([[0, -1, -1]], np.int32)
+    _, _, _, s = drain_pool_np(
+        np.zeros(1, np.int64), None, rows,
+        np.full(4, 10 ** 10, np.int64), np.full(4, 2, np.int64),
+        shard_bytes=1, stream_bytes=10 ** 9, t_us=30_000_000, n=1,
+        size=3, tol=1)
+    assert s["risk_us"] == 30_000_000
+    assert s["drained"] == 0 and s["queued"] == 0
+
+
+# ------------------------------------------------------- queue vs flat A/B
+
+
+def test_queue_vs_flat_ab_and_flat_floor():
+    """The A/B: the flat model's epoch duration follows the legacy
+    one-division formula (silently flooring sub-interval drains); the
+    queue model keeps fixed intervals and carries the remainder as
+    backlog.  Same scenario, different models, different digests —
+    and spec() pins the model."""
+    base = ("epochs=6,seed=3,hosts=6,osds_per_host=2,racks=2,pgs=32,"
+            "ec=,size=3,balance_every=0,spotcheck_every=0,"
+            "checkpoint_every=0,p_flap=0,p_death=1.0,p_remove=0,"
+            "p_host_outage=0,p_rack_outage=0,p_reweight=0,p_pg_temp=0,"
+            "p_pool_create=0,p_split=0,p_expand=0,interval_s=10,"
+            "recovery_mbps=50,pg_gb=1.0")
+    flat = LifetimeSim(Scenario.parse(base + ",recovery=flat"),
+                       backend="ref")
+    fout = flat.run()
+    # legacy formula replay: every epoch >= interval_s, and an epoch
+    # that moved shards longer than the interval stretched to
+    # moved_bytes / rate
+    assert fout["sim_seconds"] >= 6 * 10
+    queue = LifetimeSim(Scenario.parse(base + ",recovery=queue"),
+                        backend="ref")
+    qout = queue.run()
+    # fixed control-plane intervals: the queue run's clock is exact
+    assert qout["sim_seconds"] == 6 * 10
+    assert qout["digest"] != fout["digest"]
+    rec = qout["recovery"]
+    assert rec["model"] == "queue"
+    # deaths moved shards: bytes were enqueued, conserved, and (at
+    # 50 MB/s against 1 GB PGs) a backlog was actually observed
+    assert rec["enqueued_gb"] > 0
+    assert rec["backlog_peak_gb"] > 0
+    assert rec["conservation_violations"] == 0
+    assert qout["invariant_violations"] == 0
+    assert "recovery=queue" in qout["scenario"]
+    assert "recovery=flat" in fout["scenario"]
+    assert fout["recovery"] is None  # flat run has no queue section
+
+
+def test_conservation_negative_control():
+    """A drain that loses bytes (tampered scalars) must surface as a
+    sim invariant violation and the recovery counter."""
+    sc = Scenario.parse(
+        "epochs=2,seed=3,hosts=4,osds_per_host=2,racks=2,pgs=16,ec=,"
+        "size=3,balance_every=0,spotcheck_every=0,checkpoint_every=0")
+    sim = LifetimeSim(sc, backend="ref")
+
+    def corrupt(pid, scal):
+        scal = dict(scal)
+        scal["drained"] += 7  # bytes from nowhere
+        return scal
+
+    sim.recovery_corrupt_hook = corrupt
+    out = sim.run()
+    assert out["epochs"] == 2  # survived, did not abort
+    assert out["invariant_violations"] > 0
+    assert any("conservation" in v for v in out["violations"])
+    assert out["recovery"]["conservation_violations"] > 0
+
+
+def test_recovery_step_fault_degrades_digest_unchanged():
+    """An armed `recovery_step` device loss degrades the drain to the
+    host mirror mid-run: fallback recorded, digest unchanged."""
+    sc = Scenario.parse(
+        "epochs=5,seed=4,hosts=6,osds_per_host=2,racks=2,pgs=32,ec=,"
+        "size=3,balance_every=0,spotcheck_every=0,checkpoint_every=0")
+    clean = LifetimeSim(sc, backend="ref").run()
+    faults.configure("recovery_step.3=lost:chaos x1")
+    sim = LifetimeSim(sc, backend="ref")
+    out = sim.run()
+    faults.disarm_all()
+    assert out["digest"] == clean["digest"]
+    assert out["recovery"]["fallback_epochs"] == 1
+    assert out["provenance"]["device_loss_fallbacks"] >= 1
+
+
+# --------------------------------------------------------------- workload
+
+
+def test_workload_pool_np_hand_computed():
+    """Traffic formula on a hand case: degraded reads, at-risk hits,
+    backlog hits, per-OSD client bytes (reads -> primary, writes -> all
+    live lanes)."""
+    rows = np.array([
+        [0, 1, 2],     # healthy
+        [1, -1, -1],   # degraded AND at risk (1 of 3, tol 1)
+        [-1, -1, -1],  # dead: unserved
+    ], np.int32)
+    backlog = np.array([10, 0, 0], np.int64)
+    seeds = np.array([0, 1, 2, 0], np.int64)
+    read = np.array([True, True, True, False])
+    client, s = workload_pool_np(
+        rows, backlog, seeds, read, wq=5, obj_bytes=100, DV=8,
+        size=3, tol=1)
+    assert s["requests"] == 20 and s["reads"] == 15 and s["writes"] == 5
+    assert s["degraded_reads"] == 5   # the read on PG 1
+    assert s["at_risk_hits"] == 10    # PGs 1 AND 2 below tolerance
+    assert s["backlog_hits"] == 10    # both PG-0 requests
+    assert s["unserved"] == 5         # PG 2
+    # osd.0: read primary on PG 0 + write lane on PG 0 = 2 * 100 * 5;
+    # osd.1: primary read on PG 1 + write lane = 1000; osd.2: write lane
+    assert client[:3].tolist() == [1000, 1000, 500]
+    assert int(client.sum()) == 2500
+
+
+def test_workload_determinism_and_seed_divergence():
+    a = LifetimeSim(Scenario.parse(TINY_WL), backend="ref").run()
+    b = LifetimeSim(Scenario.parse(TINY_WL), backend="ref").run()
+    assert a["digest"] == b["digest"]
+    assert a["workload"] == b["workload"]
+    c = LifetimeSim(Scenario.parse(TINY_WL + ",seed=6"),
+                    backend="ref").run()
+    assert c["digest"] != a["digest"]
+    # the generator actually served traffic and saw the chaos
+    assert a["workload"]["requests"] > 0
+    assert a["workload"]["served_qps"] > 0
+    assert a["pareto"]["served_qps"] == a["workload"]["served_qps"]
+
+
+def test_workload_digest_segments_only_when_enabled():
+    """Turning the generator on must change the digest (new |W
+    segments); the workload-off run chains the legacy lines."""
+    base = TINY_WL.replace(",workload=1", "")
+    off = LifetimeSim(Scenario.parse(base), backend="ref").run()
+    on = LifetimeSim(Scenario.parse(TINY_WL), backend="ref").run()
+    assert off["digest"] != on["digest"]
+    assert off["workload"] is None
+
+
+def test_workload_contention_throttles_clients():
+    """A starved cluster (tiny per-OSD capacity, heavy QPS) must book
+    throttled client bytes and contended OSD-epochs."""
+    sc = Scenario.parse(
+        "epochs=3,seed=2,hosts=4,osds_per_host=2,racks=2,pgs=16,ec=,"
+        "size=3,balance_every=0,spotcheck_every=0,checkpoint_every=0,"
+        "workload=1,base_qps=50000,obj_kb=512,osd_mbps=1")
+    out = LifetimeSim(sc, backend="ref").run()
+    wl = out["workload"]
+    assert wl["throttled_gb"] > 0
+    assert wl["contended_osd_epochs"] > 0
+
+
+def test_resume_with_workload_and_queue(tmp_path):
+    """Digest-exact resume with BOTH subsystems enabled: backlog
+    vectors and workload tallies restore bit-exactly."""
+    sc = Scenario.parse(TINY_WL)
+    straight = LifetimeSim(sc, backend="ref").run()
+    ck = tmp_path / "ck.json"
+    LifetimeSim(sc, backend="ref", checkpoint=str(ck)).run(stop_after=4)
+    resumed = LifetimeSim(sc, backend="ref", checkpoint=str(ck),
+                          resume=True)
+    assert resumed.resumed_from == 4
+    out = resumed.run()
+    assert out["digest"] == straight["digest"]
+    assert out["workload"]["requests"] == \
+        straight["workload"]["requests"]
+    assert out["recovery"]["enqueued_gb"] == \
+        straight["recovery"]["enqueued_gb"]
+
+
+def test_resume_rejects_model_mix(tmp_path):
+    """spec() pins the recovery model: a queue checkpoint can never be
+    resumed under flat (and vice versa)."""
+    ck = tmp_path / "ck.json"
+    sc = Scenario.parse(TINY_WL + ",epochs=2")
+    LifetimeSim(sc, backend="ref", checkpoint=str(ck)).run()
+    other = Scenario.parse(TINY_WL + ",epochs=2,recovery=flat")
+    with pytest.raises(ValueError, match="different scenario"):
+        LifetimeSim(other, backend="ref", checkpoint=str(ck),
+                    resume=True)
+
+
+def test_scenario_rejects_unknown_recovery_model():
+    with pytest.raises(ValueError, match="recovery="):
+        Scenario.parse("epochs=2,recovery=bogus")
+
+
+# ------------------------------------------------------------- slow tier
+
+
+@pytest.mark.slow
+def test_drain_and_workload_kernels_bit_identical_to_numpy():
+    """The device executors against the authoritative numpy formulas on
+    a seeded random input: every output int64 must match exactly."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.recovery.queue import _drain_account
+    from ceph_tpu.sim.workload import _wl_account
+
+    rng = np.random.default_rng(7)
+    N, W, DV, S = 32, 3, 32, 16
+    rows = rng.integers(-1, 12, size=(N, W)).astype(np.int32)
+    backlog = rng.integers(0, 5, size=N).astype(np.int64) * 10 ** 9
+    moved = rng.integers(0, 3, size=N).astype(np.int64)
+    cap = np.full(DV, 3 * 10 ** 9, np.int64)
+    slots = np.full(DV, 2, np.int64)
+    kw = dict(shard_bytes=333_333_333, stream_bytes=3 * 10 ** 9,
+              t_us=30_000_000, n=N, size=3, tol=1)
+    bh, ch, sh, sch = drain_pool_np(backlog, moved, rows, cap.copy(),
+                                    slots.copy(), **kw)
+    bd, cd, sd, scd = _drain_account((N, W, DV))(
+        jnp.asarray(backlog), jnp.asarray(moved), jnp.asarray(rows),
+        jnp.asarray(cap), jnp.asarray(slots), np.int64(333_333_333),
+        np.int64(3 * 10 ** 9), np.int64(30_000_000), np.uint32(N),
+        np.int32(3), np.int32(1))
+    assert np.array_equal(bh, np.asarray(bd))
+    assert np.array_equal(ch, np.asarray(cd))
+    assert np.array_equal(sh, np.asarray(sd))
+    assert list(sch.values()) == [int(v) for v in np.asarray(scd)]
+
+    seeds = rng.integers(0, N, size=S).astype(np.int64)
+    read = rng.random(S) < 0.7
+    clh, wsh = workload_pool_np(rows, backlog, seeds, read, wq=11,
+                                obj_bytes=65536, DV=DV, size=3, tol=1)
+    cld, wsd = _wl_account((N, W, DV, S))(
+        jnp.asarray(rows), jnp.asarray(backlog), jnp.asarray(seeds),
+        jnp.asarray(read), np.int64(11), np.int64(65536), DV,
+        np.int32(3), np.int32(1))
+    assert np.array_equal(clh, np.asarray(cld))
+    assert list(wsh.values()) == [int(v) for v in np.asarray(wsd)]
+
+
+@pytest.mark.slow
+def test_at_scale_queue_workload_jax():
+    """200 chaos epochs on the jax backend with BOTH subsystems on:
+    0 violations (conservation included), 0 steady compiles, backlog
+    observed, served QPS recorded."""
+    sc = Scenario.parse(
+        "epochs=200,seed=11,hosts=6,osds_per_host=2,racks=2,pgs=64,"
+        "ec=2+2,ec_pgs=32,chunk=512,balance_every=32,"
+        "spotcheck_every=32,checkpoint_every=0,workload=1,"
+        "pipeline_repair=1,max_pools=3,max_pgs=128,max_expand=2")
+    out = LifetimeSim(sc, backend="jax").run()
+    assert out["epochs"] == 200
+    assert out["invariant_violations"] == 0, out["violations"][:5]
+    assert out["trace_once"]["steady_compiles"] == 0
+    assert out["recovery"]["conservation_violations"] == 0
+    assert out["recovery"]["backlog_peak_gb"] > 0
+    assert out["workload"]["served_qps"] > 0
+    assert out["pareto"]["cluster_years_per_hour"] > 0

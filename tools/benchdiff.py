@@ -67,7 +67,13 @@ from pathlib import Path
 #     that stops being O(delta) is semantic drift, never hardware
 #     variance).  lifetime.epochs_per_sec (already v4) is where the
 #     refactor's uplift lands, calibration-normalized as before.
-SCHEMA_VERSION = 6
+# v7: adds the recovery data plane + client workload sections
+#     (`lifetime.recovery.*` / `lifetime.workload.*` / the pareto
+#     headline): conservation violations, degraded-reads-served,
+#     at-risk hits and backlog counts are seeded-deterministic —
+#     compared raw; served QPS and the observed backlog-drain rate
+#     are calibration-normalized hardware rates.
+SCHEMA_VERSION = 7
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -338,6 +344,33 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         True, False)
     put("lifetime.state.full_rebuilds", lst.get("full_rebuilds"),
         False, False)
+    # recovery data plane + client workload (v7): the scenario is
+    # seeded, so every byte/hit tally is bit-determined — conservation
+    # violations, degraded reads, at-risk/backlog hits compare raw
+    # (semantic drift); served QPS (the pareto service level) and the
+    # observed wall-clock drain rate are hardware rates.
+    rcv = lf.get("recovery") or {}
+    put("lifetime.recovery.conservation_violations",
+        rcv.get("conservation_violations"), False, False)
+    put("lifetime.recovery.backlog_peak_gb",
+        rcv.get("backlog_peak_gb"), False, False)
+    put("lifetime.recovery.completed_pgs", rcv.get("completed_pgs"),
+        True, False)
+    put("lifetime.recovery.fallback_epochs",
+        rcv.get("fallback_epochs"), False, False)
+    put("lifetime.recovery.drain_gbps", rcv.get("drain_gbps"),
+        True, True)
+    wl = lf.get("workload") or {}
+    put("lifetime.workload.served_qps", wl.get("served_qps"),
+        True, True)
+    put("lifetime.workload.degraded_reads", wl.get("degraded_reads"),
+        False, False)
+    put("lifetime.workload.at_risk_hits", wl.get("at_risk_hits"),
+        False, False)
+    put("lifetime.workload.unserved", wl.get("unserved"),
+        False, False)
+    put("lifetime.workload.contended_osd_epochs",
+        wl.get("contended_osd_epochs"), False, False)
     # serving daemon (v5): the client-visible story.  Load and swap
     # cadence are seeded, so the never-dropped / shed / stall /
     # steady-compile counts and the recovery proof bit are semantic
