@@ -89,6 +89,15 @@ swaps (swap stall bounded and recorded), an injected mid-traffic
 device loss answered host-side, a deterministic overload burst (EBUSY
 shedding), and a chaos phase where the lifetime engine churns epochs
 against the live service.
+
+`python bench.py --multichip` is the mesh-scaling record: per device
+count (BENCH_MC_DEVICES, default 1,2,8) a fresh subprocess self-forces
+that many virtual host devices, shards the production pipeline over a
+CEPH_TPU_MESH_DEVICES mesh, and measures map throughput, a lifetime
+chaos digest that must be bit-identical across all counts, and the
+candidate-batched vs sequential optimizer dispatch ratio (>=5x gate).
+Knobs: BENCH_MC_DEVICES/_PGS/_OSDS/_CHUNK/_REPS/_SCENARIO/_TIMEOUT/
+_BACKEND/_BAL_PGS/_BAL_OSDS/_BAL_ITER.
 """
 
 from __future__ import annotations
@@ -419,24 +428,42 @@ def bench_rebalance(n_pgs: int, n_osds: int, rounds: int,
     return res
 
 
+def _balancer_snap() -> dict:
+    d = obs.perf_dump().get("balancer") or {}
+    return {k: int(d.get(k, 0)) for k in (
+        "changes_accepted", "changes_rejected", "candidate_batches",
+        "candidates_scored")}
+
+
 def bench_balancer(n_pgs: int, n_osds: int, compat_iters: int) -> dict:
     """One optimization round of EACH mgr balancer mode on a synthetic
     cluster (the reference's `ceph balancer optimize` pair: do_upmap /
     do_crush_compat, pybind/mgr/balancer/module.py:964/1031), scored by
     calc_eval through the batched pipeline.  Records per-mode wall
-    time, score delta, and eval throughput (PGs scored per second)."""
+    time, score delta, and eval throughput (PGs scored per second) —
+    plus a candidate-batched upmap run on an identical fresh map, whose
+    `dispatches_per_change` (candidate_batches / changes_accepted)
+    against the sequential path's one-eval-per-change ratio is the
+    batched-optimizer proof benchdiff tracks (schema v8)."""
     from ceph_tpu.mgr import Balancer, MappingState, synthetic_pg_stats
 
-    m = build_map(n_pgs, n_osds)
-    rng = np.random.default_rng(9)
-    for o in rng.choice(n_osds, max(1, n_osds // 25), replace=False):
-        m.osd_weight[int(o)] = int(0x10000 * 0.8)
+    def mk_map():
+        m = build_map(n_pgs, n_osds)
+        rng = np.random.default_rng(9)
+        for o in rng.choice(n_osds, max(1, n_osds // 25),
+                            replace=False):
+            m.osd_weight[int(o)] = int(0x10000 * 0.8)
+        return m
+
+    m = mk_map()
     res: dict = {"pgs": n_pgs, "osds": n_osds}
     stats = synthetic_pg_stats(m)
+    seq_ratio = None
     for mode, opts in (
         ("upmap", {"upmap_max_optimizations": 16}),
         ("crush-compat", {"crush_compat_max_iterations": compat_iters}),
     ):
+        b0 = _balancer_snap()
         bal = Balancer(options=opts, rng=np.random.default_rng(17))
         ms = MappingState(m, stats, mapper="jax")
         before = obs.perf_dump()["mgr"]["eval_pgs_mapped"]
@@ -470,9 +497,58 @@ def bench_balancer(n_pgs: int, n_osds: int, compat_iters: int) -> dict:
                 len(plan.inc.new_pg_upmap_items)
                 + len(plan.inc.old_pg_upmap_items)
             )
+            b1 = _balancer_snap()
+            acc = b1["changes_accepted"] - b0["changes_accepted"]
+            rej = b1["changes_rejected"] - b0["changes_rejected"]
+            # the sequential greedy evaluates exactly one prospective
+            # change per accepted/rejected round-trip
+            seq_ratio = round((acc + rej) / max(acc, 1), 4)
+            entry["dispatches_per_change"] = seq_ratio
         else:
             entry["weight_set_osds"] = len(plan.compat_ws)
         res[mode.replace("-", "_")] = entry
+
+    # candidate-batched upmap on an identical fresh map: same budget,
+    # whole batches of prospective changes scored per dispatch
+    cand_k = int(os.environ.get("BENCH_BAL_CAND", 16))
+    m2 = mk_map()
+    bal = Balancer(
+        options={"upmap_max_optimizations": 16,
+                 "upmap_candidate_batch": cand_k,
+                 "upmap_state_backend": "device"},
+        rng=np.random.default_rng(17),
+    )
+    ms = MappingState(m2, stats, mapper="jax")
+    b0 = _balancer_snap()
+    t0 = time.perf_counter()
+    with obs.span("bench.balancer", mode="upmap_batched", pgs=n_pgs):
+        pe0 = bal.eval(ms)
+        plan = bal.plan_create("bench-batched", ms, mode="upmap")
+        rc, _ = bal.optimize(plan)
+        pe1 = bal.eval(plan.final_state()) if rc == 0 else pe0
+    dt = time.perf_counter() - t0
+    b1 = _balancer_snap()
+    acc = b1["changes_accepted"] - b0["changes_accepted"]
+    batches = b1["candidate_batches"] - b0["candidate_batches"]
+    cb = {
+        "rc": rc,
+        "wall_s": round(dt, 2),
+        "candidate_batch": cand_k,
+        "batches": batches,
+        "scored": b1["candidates_scored"] - b0["candidates_scored"],
+        "changes": acc,
+        "score_before": round(pe0.score, 6),
+        "score_after": round(pe1.score, 6),
+        "dispatches_per_change": round(batches / max(acc, 1), 4),
+    }
+    res["upmap_batched"] = cb
+    # the benchdiff metric pair (schema v8): batched vs sequential
+    # scoring dispatches per accepted change
+    res["dispatches_per_change"] = cb["dispatches_per_change"]
+    res["seq_dispatches_per_change"] = seq_ratio
+    if seq_ratio and acc:
+        res["dispatch_reduction_x"] = round(
+            seq_ratio / max(cb["dispatches_per_change"], 1e-9), 1)
     return res
 
 
@@ -1243,6 +1319,249 @@ def worker() -> None:
                 obs.executables.dump(analyze="full", budget_s=20.0))
 
 
+# -------------------------------------------------------------- multichip
+#
+# `python bench.py --multichip` — the mesh-scaling record: for each
+# device count, a FRESH subprocess self-forces that many virtual host
+# devices (the parent's jax runtime is already initialized and cannot
+# grow — exactly the sharded.py erroring path this replaces), builds the
+# CEPH_TPU_MESH_DEVICES mesh, and measures the PRODUCTION sharded paths:
+# ClusterState mapping throughput, a lifetime chaos run whose SHA-256
+# digest must be bit-identical across every device count, and the
+# candidate-batched vs sequential optimizer dispatch ratio.  The parent
+# assembles one MULTICHIP-shaped JSON (tools/benchdiff folds it as the
+# multichip trajectory, schema v8).  `backend=tpu`-ready: set
+# BENCH_MC_BACKEND=tpu to skip the CPU forcing and run on real devices.
+
+MC_DEVICES = os.environ.get("BENCH_MC_DEVICES", "1,2,8")
+MC_PGS = int(os.environ.get("BENCH_MC_PGS", 65536))
+MC_OSDS = int(os.environ.get("BENCH_MC_OSDS", 256))
+MC_CHUNK = int(os.environ.get("BENCH_MC_CHUNK", 16384))
+MC_REPS = int(os.environ.get("BENCH_MC_REPS", 3))
+MC_SCENARIO = os.environ.get(
+    "BENCH_MC_SCENARIO",
+    "epochs=48,seed=11,hosts=4,osds_per_host=3,racks=2,pgs=128,"
+    "ec=2+1,ec_pgs=32,chunk=1024,balance_every=16,balance_max=4,"
+    "spotcheck_every=16,checkpoint_every=0,recovery=flat,workload=0",
+)
+MC_TIMEOUT = float(os.environ.get("BENCH_MC_TIMEOUT", 420))
+
+
+def _mc_optimizer_ab(mesh) -> dict:
+    """Sequential vs candidate-batched calc_pg_upmaps on identical
+    skewed maps (device backend, rows sharded over `mesh`): the
+    counter-proven dispatches-per-accepted-change ratio and the
+    plan-quality parity check."""
+    from ceph_tpu.balancer.upmap import calc_pg_upmaps
+
+    pgs = int(os.environ.get("BENCH_MC_BAL_PGS", 8192))
+    osds = int(os.environ.get("BENCH_MC_BAL_OSDS", 128))
+    budget = int(os.environ.get("BENCH_MC_BAL_ITER", 64))
+    max_dev = 2
+
+    def mk():
+        m = build_map(pgs, osds)
+        rng = np.random.default_rng(5)
+        for o in rng.choice(osds, max(2, osds // 10), replace=False):
+            m.osd_weight[int(o)] = int(0x10000 * 0.6)
+        return m
+
+    out: dict = {"pgs": pgs, "osds": osds, "budget": budget}
+    for name, kw in (("sequential", {}),
+                     ("batched", {"candidate_batch": 32})):
+        m = mk()
+        s0 = _balancer_snap()
+        t0 = time.perf_counter()
+        r = calc_pg_upmaps(
+            m, max_deviation=max_dev, max_iter=budget,
+            rng=np.random.default_rng(100), backend="device",
+            mesh=mesh, **kw,
+        )
+        dt = time.perf_counter() - t0
+        s1 = _balancer_snap()
+        acc = s1["changes_accepted"] - s0["changes_accepted"]
+        rej = s1["changes_rejected"] - s0["changes_rejected"]
+        bat = s1["candidate_batches"] - s0["candidate_batches"]
+        evals = bat if kw else acc + rej
+        out[name] = {
+            "wall_s": round(dt, 2),
+            "changes": r.num_changed,
+            "max_deviation": round(float(r.max_deviation), 2),
+            "stddev": round(float(r.stddev), 1),
+            "evals": evals,
+            "dispatches_per_change": round(evals / max(acc, 1), 4),
+        }
+    s, b = out["sequential"], out["batched"]
+    out["dispatch_reduction_x"] = round(
+        s["dispatches_per_change"]
+        / max(b["dispatches_per_change"], 1e-9), 1)
+    # no worse at equal max_deviation: the batched plan lands at most
+    # where the sequential one did (or inside the requested bound)
+    out["quality_no_worse"] = bool(
+        b["max_deviation"]
+        <= max(s["max_deviation"], float(max_dev)) + 1e-6)
+    out["dispatches_per_change"] = b["dispatches_per_change"]
+    return out
+
+
+def _mc_worker(n: int) -> None:
+    """One device-count measurement, in a fresh self-forced process."""
+    backend = os.environ.get("BENCH_MC_BACKEND", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (backend == "cpu"
+            and "xla_force_host_platform_device_count" not in flags):
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    if backend == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    from ceph_tpu.osd.state import ClusterState
+    from ceph_tpu.parallel.sharded import (
+        default_mesh,
+        last_mesh_provenance,
+    )
+    from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+    out: dict = {"n": n}
+    with obs.span("bench.multichip", devices=n):
+        # parent exported CEPH_TPU_MESH_DEVICES=n; a mesh that came up
+        # smaller than asked is visible in the provenance and fails the
+        # parent's mesh_ok gate — a degraded mesh can never masquerade
+        # as a scaling run
+        mesh = default_mesh()
+        out["mesh"] = last_mesh_provenance()
+        m = build_map(MC_PGS, MC_OSDS)
+        state = ClusterState(m, chunk=MC_CHUNK, mesh=mesh)
+        pm = state.mapper(0)
+        jax.block_until_ready(pm.map_all_device(MC_CHUNK))  # warm
+        jit0 = _jit_counters()
+        t0 = time.perf_counter()
+        for _ in range(MC_REPS):
+            rows = pm.map_all_device(MC_CHUNK)
+        jax.block_until_ready(rows)
+        dt = (time.perf_counter() - t0) / MC_REPS
+        out["map"] = {
+            "pgs": MC_PGS,
+            "mappings_per_sec": round(MC_PGS / dt, 1),
+            "warm_jit": _jit_delta(jit0),
+        }
+        sim = LifetimeSim(Scenario.parse(MC_SCENARIO), backend="jax",
+                          mesh=mesh)
+        lt = sim.run()
+        out["lifetime"] = {
+            "digest": lt["digest"],
+            "epochs": lt["epochs"],
+            "epochs_per_sec": lt["epochs_per_sec"],
+            "steady_compiles": lt["trace_once"]["steady_compiles"],
+            "violations": lt["invariant_violations"],
+        }
+        if os.environ.get("BENCH_MC_OPT"):
+            out["balancer"] = _mc_optimizer_ab(mesh)
+    print(json.dumps(out))
+
+
+def multichip_supervise(devices: list[int]) -> int:
+    t_all = time.time()
+    maxn = max(devices)
+    results: dict = {}
+    notes: list[str] = []
+    for n in devices:
+        env = dict(os.environ, BENCH_MC_WORKER=str(n),
+                   CEPH_TPU_MESH_DEVICES=str(n))
+        env.pop("BENCH_WORKER", None)
+        if n == maxn:
+            env["BENCH_MC_OPT"] = "1"
+        _log(f"multichip: measuring {n} device(s)")
+        t0 = time.time()
+        rec: dict = {"n": n}
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(Path(__file__).resolve()),
+                 "--multichip"],
+                env=env, capture_output=True, text=True,
+                timeout=MC_TIMEOUT,
+            )
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+            if proc.returncode != 0:
+                notes.append(f"{n}-device worker rc={proc.returncode}")
+        except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
+            notes.append(f"{n}-device worker failed: {e!r}"[:200])
+            rec["error"] = f"{type(e).__name__}"
+        rec["wall_s"] = round(time.time() - t0, 1)
+        results[n] = rec
+    digests = {n: (r.get("lifetime") or {}).get("digest")
+               for n, r in results.items()}
+    vals = [d for d in digests.values() if d]
+    digest_match = (len(vals) == len(devices)
+                    and len(set(vals)) == 1)
+    # n=1 runs meshless by design (default_mesh: <=1 = single-device,
+    # the baseline the digests are compared against)
+    mesh_ok = all(
+        (not (r.get("mesh") or {}) if n <= 1
+         else (r.get("mesh") or {}).get("actual") == n)
+        for n, r in results.items())
+    steadies = [(r.get("lifetime") or {}).get("steady_compiles", -1)
+                for r in results.values()]
+    steady = max(steadies) if steadies else -1
+    maxrec = results.get(maxn) or {}
+    bal = maxrec.get("balancer") or {}
+    ok = bool(
+        digest_match and mesh_ok and steady == 0
+        and not notes
+        and bal.get("dispatch_reduction_x", 0) >= 5
+        and bal.get("quality_no_worse", False)
+    )
+    out = {
+        "n_devices": maxn,
+        "rc": 0 if ok else 1,
+        "ok": ok,
+        "skipped": False,
+        "schema_version": SCHEMA_VERSION,
+        "backend": os.environ.get("BENCH_MC_BACKEND", "cpu"),
+        "scaling": {
+            "devices": maxn,
+            "digest_match": digest_match,
+            "eps_per_device": round(
+                ((maxrec.get("lifetime") or {})
+                 .get("epochs_per_sec") or 0.0) / maxn, 3),
+            "maps_per_sec_per_device": round(
+                ((maxrec.get("map") or {})
+                 .get("mappings_per_sec") or 0.0) / maxn, 1),
+            "steady_compiles": steady,
+        },
+        "balancer": bal,
+        "mesh_ok": mesh_ok,
+        "workers": {str(n): r for n, r in results.items()},
+        "cpu_threads": os.cpu_count(),
+        "elapsed_s": round(time.time() - t_all, 1),
+    }
+    if (out["backend"] == "cpu"
+            and maxn > (os.cpu_count() or 1)):
+        notes = notes + [
+            f"forced {maxn} virtual devices on {os.cpu_count()} CPU "
+            "thread(s): partitioning overhead without physical "
+            "parallelism — wall-clock scaling needs real chips "
+            "(BENCH_MC_BACKEND=tpu); the digest-match / 0-compile / "
+            "dispatch-ratio proofs are hardware-independent"
+        ]
+    if notes:
+        out["notes"] = notes
+    out["tail"] = (
+        f"multichip {'ok' if ok else 'FAIL'}: {maxn} devices, "
+        f"digest {'match' if digest_match else 'MISMATCH'}, "
+        f"{bal.get('dispatch_reduction_x', 0)}x fewer "
+        "dispatches/change"
+    )
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 # -------------------------------------------------------------- supervisor
 
 def _strip_perf(stage):
@@ -1607,6 +1926,18 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
             "benchdiff did not flag the recovery/workload regression "
             "seeded in the fixture series (schema v7 metrics not "
             "folded)")
+    elif not any(d["metric"].startswith("multichip.scaling.")
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the multichip scaling regression "
+            "seeded in the fixture series (schema v8 multichip.scaling "
+            "metrics not folded)")
+    elif not any(d["metric"] == "balancer.dispatches_per_change"
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the candidate-batched optimizer "
+            "regression seeded in the fixture series (schema v8 "
+            "balancer.dispatches_per_change not folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -1801,6 +2132,25 @@ def selftest() -> int:
                 f"serve chaos dropped {cz.get('dropped')} queries")
         if not cz.get("swaps_ok", 0) > 0:
             problems.append("serve chaos applied no epoch swaps")
+        # candidate-batched optimizer gate: the balancer stage must
+        # record the dispatches-per-change pair, and batching may never
+        # cost MORE scoring dispatches per accepted change than the
+        # sequential path (the >=5x headline proof lives in the
+        # MULTICHIP record, where the cluster is big enough to batch)
+        blc = out.get("balancer") or {}
+        if blc.get("dispatches_per_change") is None \
+                or blc.get("seq_dispatches_per_change") is None:
+            problems.append(
+                "balancer stage missing the dispatches_per_change / "
+                "seq_dispatches_per_change pair (candidate-batched "
+                "optimizer not recorded)")
+        elif ((blc.get("upmap_batched") or {}).get("changes", 0) > 0
+                and blc["dispatches_per_change"]
+                > blc["seq_dispatches_per_change"]):
+            problems.append(
+                "candidate-batched optimizer booked MORE dispatches "
+                f"per change ({blc['dispatches_per_change']}) than the "
+                f"sequential path ({blc['seq_dispatches_per_change']})")
     lint = _selftest_graftlint(problems)
     execs = _selftest_executables(out, problems)
     bdiff = _selftest_benchdiff(problems)
@@ -1840,6 +2190,12 @@ def selftest() -> int:
                      "degraded_answered", "device_loss_recovered",
                      "chaos")
         } or None,
+        "balancer": {
+            k: v for k, v in (out.get("balancer") or {}).items()
+            if k in ("dispatches_per_change",
+                     "seq_dispatches_per_change",
+                     "dispatch_reduction_x")
+        } or None,
         "benchdiff": bdiff,
     }
     if problems:
@@ -1850,6 +2206,12 @@ def selftest() -> int:
 
 
 if __name__ == "__main__":
+    if "--multichip" in sys.argv:
+        if os.environ.get("BENCH_MC_WORKER"):
+            _mc_worker(int(os.environ["BENCH_MC_WORKER"]))
+            raise SystemExit(0)
+        raise SystemExit(multichip_supervise(
+            [int(x) for x in MC_DEVICES.split(",") if x.strip()]))
     if "--selftest" in sys.argv:
         raise SystemExit(selftest())
     if os.environ.get("BENCH_WORKER"):

@@ -69,6 +69,16 @@ class SetState:
     def deviations(self):
         return self._dev(self.pbo)
 
+    def counts_np(self, n: int) -> np.ndarray:
+        """Dense per-OSD membership counts i64[n] — the candidate-batch
+        scorer's base vector (same numbers _dev derives deviations
+        from)."""
+        counts = np.zeros(n, np.int64)
+        for osd, pgs in self.pbo.items():
+            if 0 <= osd < n:
+                counts[osd] = len(pgs)
+        return counts
+
     def pgs_of(self, osd):
         return sorted(self.pbo.get(osd, ()))
 
@@ -199,6 +209,14 @@ class DeviceState:
 
     def deviations(self):
         return self._dev_from_counts(self.counts)
+
+    def counts_np(self, n: int) -> np.ndarray:
+        """Dense per-OSD membership counts i64[n] (host mirror of the
+        device rows' histogram; max_osd-bounded)."""
+        out = np.zeros(n, np.int64)
+        k = min(n, len(self.counts))
+        out[:k] = self.counts[:k]
+        return out
 
     # -- membership query ------------------------------------------------
     def pgs_of(self, osd):

@@ -241,6 +241,20 @@ _L.add_time_avg("build_state_seconds",
 _L.add_u64("state_rows_reused",
            "membership builds served from the shared ClusterState's "
            "version-tagged device rows (no O(PGs) mapping pass)")
+# the candidate-batched optimizer (calc_pg_upmaps candidate_batch>0):
+# the sequential path books one accepted/rejected evaluation round-trip
+# per prospective change; the batched path scores a whole batch per
+# dispatch, so candidate_batches / changes_accepted is the
+# dispatches-per-accepted-change ratio the bench records
+_L.add_u64("candidate_batches",
+           "candidate-scoring batch evaluations (one vectorized "
+           "deviation-delta kernel per batch of prospective changes)")
+_L.add_u64("candidates_scored",
+           "prospective pg_upmap changes scored in candidate batches")
+_L.add_u64("candidate_conflicts",
+           "scored candidates skipped by the non-conflicting-subset "
+           "rule (an accepted candidate already touched one of their "
+           "OSDs or PGs)")
 
 
 @dataclass
@@ -309,6 +323,341 @@ def _build_pgs_by_osd(
     return pgs_by_osd
 
 
+# -- candidate-batched optimizer --------------------------------------------
+# The sequential greedy (below) evaluates ONE prospective change per
+# round-trip — the dispatch-bound analogue of the load-imbalance problem
+# ("Rateless Codes for Near-Perfect Load Balancing...", PAPERS.md).  The
+# batched form scores a whole batch of prospective pg_upmap changes in
+# one vectorized deviation-delta kernel (device-side on the "device"
+# backend), accepts the best NON-CONFLICTING subset host-side — the
+# squared-deviation objective is separable per OSD, so OSD-disjoint
+# candidates with negative deltas are each a guaranteed independent
+# improvement — and iterates.  Dispatches per accepted change collapse
+# from ~1:1 to ~1:N (candidate_batches / changes_accepted).
+
+_CAND_PAD = 32  # candidate axis cycle-pads to multiples of this: one
+                # compiled scoring shape per (OSD bound, slot width)
+
+_SCORE_ACCTS: dict = {}
+
+
+def _score_math(xp, counts, target, inw, osd, sgn, dv):
+    """Sum-of-squares deviation delta of applying each candidate's moves
+    alone.  Candidates are [K, S] slot arrays of (osd id, ±1 count
+    delta); osd<0 = empty slot.  With a_j the masked slot delta, w the
+    in-weight-set mask and dev_j = counts[o_j] - target[o_j]:
+
+        d(sum_sq) = Σ_j 2·a_j·w_j·dev_j + Σ_{j,j'} a_j·a_j'·w_j·[o_j=o_j']
+
+    (the exact expansion of Σ_o (c_o+d_o-t_o)² - (c_o-t_o)², duplicate
+    OSDs inside one candidate included).  One expression, executed
+    identically by jnp (device) and numpy (the "sets" mirror), so the
+    backend cannot change an accept decision's sign."""
+    ok = (osd >= 0) & (osd < dv)
+    o = xp.clip(osd, 0, dv - 1)
+    a = xp.where(ok, sgn, 0.0)
+    w = inw[o]
+    dev = counts.astype(xp.float64)[o] - target[o]
+    lin = xp.sum(2.0 * a * w * dev, axis=1)
+    eq = (o[:, :, None] == o[:, None, :]) \
+        & ok[:, :, None] & ok[:, None, :]
+    quad = xp.sum(
+        a[:, :, None] * a[:, None, :] * w[:, :, None] * eq,
+        axis=(1, 2))
+    return lin + quad
+
+
+def _score_account(dv: int):
+    """The jitted candidate scorer, one executable per OSD bound,
+    registered in the executables registry like every trace-once
+    kernel."""
+    acct = _SCORE_ACCTS.get(dv)
+    if acct is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _score(counts, target, inw, osd, sgn):
+            return _score_math(jnp, counts, target, inw, osd, sgn, dv)
+
+        jfn = jax.jit(_score)
+        rec = obs.executables.register(
+            "balancer", "cand_score", ("cand_score", dv), fn=jfn)
+        acct = _SCORE_ACCTS[dv] = obs.JitAccount(
+            jfn, _L, "cand_score", exec_record=rec)
+    return acct
+
+
+def _classify_deviations(by_dev, max_deviation):
+    """Overfull/underfull partition of the ascending (deviation, osd)
+    list — the shared front half of both optimizer loops (reference
+    OSDMap.cc:4707-4732)."""
+    overfull: set[int] = set()
+    more_overfull: set[int] = set()
+    underfull: list[int] = []
+    more_underfull: list[int] = []
+    for osd, d in reversed(by_dev):
+        if d <= 0:
+            break
+        if d > max_deviation:
+            overfull.add(osd)
+        else:
+            more_overfull.add(osd)
+    for osd, d in by_dev:
+        if d >= 0:
+            break
+        if d < -max_deviation:
+            underfull.append(osd)
+        else:
+            more_underfull.append(osd)
+    return overfull, more_overfull, underfull, more_underfull
+
+
+def _gen_candidates(m, st, by_dev, osd_deviation, overfull, underfull,
+                    more_underfull, using_more_overfull, max_deviation,
+                    only_pools, rng, aggressive, limit):
+    """Up to `limit` prospective changes, AT MOST ONE per overfull OSD —
+    each found exactly the way the sequential loop finds its single
+    change (drop remaps INTO the osd, else add a pair via try_pg_upmap)
+    but WITHOUT applying anything; the scorer arbitrates afterwards.
+    Falls back to the underfull drop pass when the overfull sweep finds
+    nothing, mirroring the sequential control flow."""
+    cands: list[dict] = []
+    seen_pgs: set = set()
+    # underfull targets consume ACROSS the batch: without this every
+    # overfull osd's try_pg_upmap picks the same most-underfull target
+    # and the non-conflicting acceptance degenerates to one change per
+    # round (the sequential rate with extra scoring)
+    used_targets: set[int] = set()
+    for osd, deviation in reversed(by_dev):
+        if len(cands) >= limit:
+            break
+        if deviation < 0:
+            break
+        if not using_more_overfull and deviation <= max_deviation:
+            break
+        if osd not in overfull:
+            continue
+        pgs = [pg for pg in st.pgs_of(osd) if pg not in seen_pgs]
+        if aggressive:
+            rng.shuffle(pgs)
+        cand = None
+        # 1) drop existing remaps INTO this overfull osd
+        for pg in pgs:
+            items = m.pg_upmap_items.get(pg)
+            if items is None:
+                continue
+            moves, new_items = [], []
+            for frm, to in items:
+                if to == osd:
+                    moves.append((to, frm))
+                else:
+                    new_items.append((frm, to))
+            if moves:
+                cand = {"pg": pg, "moves": moves,
+                        "unmap": not new_items, "items": new_items}
+                break
+        # 2) add a new remapping pair
+        if cand is None:
+            for pg in pgs:
+                if pg in m.pg_upmap:
+                    continue
+                pool = m.get_pg_pool(pg.pool)
+                new_items = list(m.pg_upmap_items.get(pg, []))
+                if len(new_items) >= pool.size:
+                    continue
+                existing: set[int] = set()
+                for frm, to in new_items:
+                    existing.add(frm)
+                    existing.add(to)
+                raw, _ = m._pg_to_raw_osds(pool, pg)
+                orig = list(raw)
+                m._apply_upmap(pool, pg, orig)
+                out = try_pg_upmap(
+                    m, pg, overfull,
+                    [o for o in underfull if o not in used_targets],
+                    [o for o in more_underfull
+                     if o not in used_targets],
+                    orig)
+                if out is None or len(out) != len(orig):
+                    continue
+                pos, max_dev = -1, 0.0
+                for i2 in range(len(out)):
+                    if orig[i2] == out[i2]:
+                        continue
+                    if orig[i2] in existing or out[i2] in existing:
+                        continue
+                    d = osd_deviation.get(orig[i2], 0.0)
+                    if d > max_dev:
+                        max_dev, pos = d, i2
+                if pos != -1:
+                    frm, to = orig[pos], out[pos]
+                    cand = {"pg": pg, "moves": [(frm, to)],
+                            "unmap": False,
+                            "items": new_items + [(frm, to)]}
+                    break
+        if cand is not None:
+            seen_pgs.add(cand["pg"])
+            for _, to in cand["moves"]:
+                used_targets.add(to)
+            cands.append(cand)
+    if not cands:
+        # underfull pass: drop pairs remapping OUT of strongly-underfull
+        # osds (the sequential loop's fallback when overfull found none)
+        for osd, deviation in by_dev:
+            if len(cands) >= limit or osd not in underfull:
+                break
+            if abs(deviation) < max_deviation:
+                break
+            candidates = [
+                (pg, items)
+                for pg, items in sorted(m.pg_upmap_items.items())
+                if pg not in seen_pgs
+                and (not only_pools or pg.pool in only_pools)
+            ]
+            if aggressive:
+                rng.shuffle(candidates)
+            for pg, items in candidates:
+                moves, new_items = [], []
+                for frm, to in items:
+                    if frm == osd:
+                        moves.append((to, frm))
+                    else:
+                        new_items.append((frm, to))
+                if moves:
+                    seen_pgs.add(pg)
+                    cands.append({"pg": pg, "moves": moves,
+                                  "unmap": not new_items,
+                                  "items": new_items})
+                    break
+    return cands
+
+
+def _score_candidates(st, cands, dv, target, inw, use_device):
+    """Score a candidate batch: ONE vectorized deviation-delta kernel
+    over [K, S] move slots (device dispatch on the "device" backend,
+    the bit-mirrored numpy expression on "sets").  Returns f64[K]."""
+    smax = max(len(c["moves"]) for c in cands)
+    S = 2
+    while S < 2 * smax:
+        S *= 2
+    K = len(cands)
+    Kp = -(-K // _CAND_PAD) * _CAND_PAD
+    osd = np.full((Kp, S), -1, np.int32)
+    sgn = np.zeros((Kp, S), np.float64)
+    for i, c in enumerate(cands):
+        for j, (frm, to) in enumerate(c["moves"]):
+            osd[i, 2 * j] = frm
+            sgn[i, 2 * j] = -1.0
+            osd[i, 2 * j + 1] = to
+            sgn[i, 2 * j + 1] = 1.0
+    counts = st.counts_np(dv)
+    _L.inc("candidate_batches")
+    _L.inc("candidates_scored", K)
+    with obs.span("balancer.score_candidates", candidates=K,
+                  device=use_device):
+        if use_device:
+            import jax.numpy as jnp
+
+            deltas = np.asarray(_score_account(dv)(
+                jnp.asarray(counts), jnp.asarray(target),
+                jnp.asarray(inw), jnp.asarray(osd), jnp.asarray(sgn),
+            ))[:K]
+        else:
+            deltas = np.asarray(_score_math(
+                np, counts, target, inw, osd, sgn, dv))[:K]
+    return deltas
+
+
+def _run_batched(m, st, res, osd_deviation, stddev,
+                 max_deviation, max_iter, only_pools, rng, aggressive,
+                 candidate_batch, use_device_scoring):
+    """The candidate-batched optimizer loop (see the block comment
+    above).  `max_iter` bounds BOTH rounds and total accepted changes —
+    the same optimization budget the sequential loop spends one change
+    per round."""
+    dv = max(int(m.max_osd), 1)
+    target = np.zeros(dv, np.float64)
+    inw = np.zeros(dv, np.float64)
+    for osd, w in st.osd_weight.items():
+        if 0 <= osd < dv:
+            target[osd] = w * st.ppw
+            inw[osd] = 1.0
+    rounds = 0
+    while rounds < max_iter and res.num_changed < max_iter:
+        rounds += 1
+        _L.inc("rounds")
+        with obs.span("balancer.round", iteration=rounds, batched=True), \
+                _L.time("round_seconds"), _L.time("round_hist"):
+            by_dev = sorted(
+                osd_deviation.items(), key=lambda kv: (kv[1], kv[0])
+            )
+            overfull, more_overfull, underfull, more_underfull = \
+                _classify_deviations(by_dev, max_deviation)
+            if not underfull and not overfull:
+                break
+            using_more = False
+            if not overfull and underfull:
+                overfull = more_overfull
+                using_more = True
+            cands = _gen_candidates(
+                m, st, by_dev, osd_deviation, overfull, underfull,
+                more_underfull, using_more, max_deviation, only_pools,
+                rng, aggressive, candidate_batch)
+            if not cands:
+                break
+            deltas = _score_candidates(
+                st, cands, dv, target, inw, use_device_scoring)
+            # best non-conflicting subset: ascending delta, skip any
+            # candidate touching an OSD an accepted one already moved
+            # ("no OSD touched twice") — disjointness makes the deltas
+            # additive, so every accept is an independent improvement
+            order = np.argsort(deltas, kind="stable")
+            txn = st.begin()
+            accepted = []
+            touched: set[int] = set()
+            for i in order:
+                if deltas[i] >= 0.0:
+                    break
+                if res.num_changed + len(accepted) >= max_iter:
+                    break
+                c = cands[i]
+                osds = {x for mv in c["moves"] for x in mv}
+                if osds & touched:
+                    _L.inc("candidate_conflicts")
+                    continue
+                for frm, to in c["moves"]:
+                    txn.move(c["pg"], frm, to)
+                touched |= osds
+                accepted.append(c)
+            if not accepted:
+                _L.inc("changes_rejected", len(cands))
+                break
+            stddev_before = stddev
+            st.commit(txn)
+            for c in accepted:
+                pg = c["pg"]
+                if c["unmap"]:
+                    if pg in m.pg_upmap_items:
+                        del m.pg_upmap_items[pg]
+                    res.old_pg_upmap_items.add(pg)
+                else:
+                    m.pg_upmap_items[pg] = list(c["items"])
+                    res.new_pg_upmap_items[pg] = list(c["items"])
+                res.num_changed += 1
+            _L.inc("changes_accepted", len(accepted))
+            osd_deviation, stddev, cur_max_deviation = st.deviations()
+            _L.observe("stddev", stddev)
+            _L.observe("max_deviation", cur_max_deviation)
+            obs.counter("balancer.stddev", stddev)
+            res.stddev = stddev
+            res.max_deviation = cur_max_deviation
+            if stddev >= stddev_before:
+                break  # float-tie guard: never loop on a non-improvement
+            if cur_max_deviation <= max_deviation:
+                break
+    return res
+
+
 def calc_pg_upmaps(
     m: OSDMap,
     max_deviation: int = 5,
@@ -322,16 +671,30 @@ def calc_pg_upmaps(
     mesh=None,
     device_cache: dict | None = None,
     rows_source=None,
+    candidate_batch: int = 0,
 ) -> UpmapResult:
     """Greedy upmap optimization; mutates m.pg_upmap_items.  Returns the
     change set (the reference's pending_inc).  reference OSDMap.cc:4634.
 
     backend: "sets" (reference-faithful dict-of-sets, small maps) or
     "device" (membership rows on device, O(OSDs) host state — the
-    10M-PG/10k-OSD form; optionally sharded over `mesh`).  Both evolve
-    the same bookkeeping; equivalence is pinned by tests/test_balancer.py.
+    10M-PG/10k-OSD form; sharded over `mesh`, defaulting to the
+    CEPH_TPU_MESH_DEVICES mesh).  Both evolve the same bookkeeping;
+    equivalence is pinned by tests/test_balancer.py.
+
+    candidate_batch: 0 = the reference-faithful sequential greedy (one
+    evaluated change per round-trip); N>0 = the candidate-batched
+    optimizer — score up to N prospective changes per vectorized
+    dispatch and accept the best non-conflicting subset (counter ratio
+    balancer.candidate_batches / changes_accepted is the
+    dispatches-per-change proof).
     """
     from ceph_tpu.balancer.state import DeviceState, SetState
+
+    if backend == "device" and mesh is None:
+        from ceph_tpu.parallel.sharded import default_mesh
+
+        mesh = default_mesh()
 
     res = UpmapResult()
     max_deviation = max(1, max_deviation)
@@ -401,6 +764,14 @@ def calc_pg_upmaps(
     if cur_max_deviation <= max_deviation:
         return res
 
+    if candidate_batch:
+        return _run_batched(
+            m, st, res, osd_deviation, stddev,
+            max_deviation, max_iter, only_pools, rng, aggressive,
+            int(candidate_batch),
+            use_device_scoring=(backend == "device"),
+        )
+
     skip_overfull = False
     iter_left = max_iter
     while iter_left > 0:
@@ -412,24 +783,8 @@ def calc_pg_upmaps(
             by_dev = sorted(
                 osd_deviation.items(), key=lambda kv: (kv[1], kv[0])
             )
-            overfull: set[int] = set()
-            more_overfull: set[int] = set()
-            underfull: list[int] = []
-            more_underfull: list[int] = []
-            for osd, d in reversed(by_dev):
-                if d <= 0:
-                    break
-                if d > max_deviation:
-                    overfull.add(osd)
-                else:
-                    more_overfull.add(osd)
-            for osd, d in by_dev:
-                if d >= 0:
-                    break
-                if d < -max_deviation:
-                    underfull.append(osd)
-                else:
-                    more_underfull.append(osd)
+            overfull, more_overfull, underfull, more_underfull = \
+                _classify_deviations(by_dev, max_deviation)
             if not underfull and not overfull:
                 break
             using_more_overfull = False

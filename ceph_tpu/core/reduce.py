@@ -12,10 +12,18 @@ over data that already lives on host in O(OSDs) form — e.g. the
 balancer's deviation bookkeeping over incrementally-maintained counts —
 deliberately stay host-side; only row-shaped inputs belong here.)
 
-All functions are plain traceable jax code (usable inside other jits /
-shard_map bodies — ceph_tpu.parallel.sharded reuses osd_histogram under a
-psum); none of them jit themselves.  `rows` is any integer array of OSD
-ids where ITEM_NONE / negative values mark empty lanes.
+All functions are plain traceable jax code (usable inside other jits);
+none of them jit themselves.  `rows` is any integer array of OSD ids
+where ITEM_NONE / negative values mark empty lanes.
+
+Mesh contract: every reduction here is shape-polymorphic over a
+PG-sharded input (rows committed to a `jax.sharding.Mesh` via
+NamedSharding — see ceph_tpu.parallel.sharded): GSPMD partitions the
+scatter-adds/compares per shard and all-reduces the tiny outputs, and
+because the accumulations are exact (integer counts; float64 weighted
+sums of integer values below 2^53) the partitioned result is
+BIT-IDENTICAL to the single-device one — which is what lets the
+sharded lifetime digest equal the unsharded digest.
 """
 
 from __future__ import annotations

@@ -83,15 +83,20 @@ class MappingState:
     """
 
     def __init__(self, osdmap: OSDMap, pg_stats=None, desc: str = "",
-                 mapper: str = "jax", state=None):
+                 mapper: str = "jax", state=None, mesh=None):
         self.osdmap = osdmap
         self.desc = desc
         self.pg_stats = pg_stats or {}
         self.mapper = mapper
         # a shared `osd.state.ClusterState`: pools whose mapping inputs
         # match its version-tagged cache are served without any mapping
-        # dispatch (the lifetime engine hands its own state in)
+        # dispatch (the lifetime engine hands its own state in).  Rows
+        # served from a meshed state arrive PG-sharded; the scoring
+        # reductions below partition over them transparently.  `mesh`
+        # shards the standalone mapping path the same way.
         self.state = state
+        self.mesh = mesh if mesh is not None \
+            else getattr(state, "mesh", None)
         self._up: dict[int, np.ndarray] = {}
         self._dev: dict[int, object] = {}
 
@@ -115,7 +120,7 @@ class MappingState:
         pool = m.pools[pool_id]
         n = pool.pg_num
         with obs.span("mgr.map_pool", pool=pool_id, pgs=n, mapper="jax"):
-            pm = PoolMapper(m, pool_id, overlays=False)
+            pm = PoolMapper(m, pool_id, overlays=False, mesh=self.mesh)
             rows = pm.map_all_device()
             seeds, fix = overlay_fixup_rows(m, pool_id, int(rows.shape[1]))
             if len(seeds):

@@ -61,6 +61,11 @@ DEFAULT_OPTIONS: dict = {
     "min_score": 0.0,
     "target_max_misplaced_ratio": 0.05,
     "upmap_state_backend": "sets",   # sets | device (balancer.state)
+    # 0 = the reference-faithful sequential greedy; N>0 = the
+    # candidate-batched optimizer (score N prospective changes per
+    # vectorized dispatch, accept the best non-conflicting subset —
+    # see balancer.upmap._run_batched)
+    "upmap_candidate_batch": 0,
 }
 
 MODES = ("none", "upmap", "crush-compat")
@@ -297,6 +302,8 @@ class Balancer:
                     only_pools={pid}, use_tpu=use_tpu, rng=self.rng,
                     backend=self.get_option("upmap_state_backend"),
                     rows_source=rows_source,
+                    candidate_batch=int(
+                        self.get_option("upmap_candidate_batch")),
                 )
             did = res.num_changed
             for pg, items in res.new_pg_upmap_items.items():

@@ -47,6 +47,9 @@ SPANS: dict[str, str] = {
     "balancer.pgs_of": "device membership query for one OSD",
     "balancer.build_state": "O(PGs) membership-state build",
     "balancer.round": "one greedy upmap optimizer round",
+    "balancer.score_candidates": "one vectorized deviation-delta "
+                                 "evaluation over a batch of "
+                                 "prospective upmap changes",
     # mgr/
     "mgr.map_pool": "eval distribution mapping pass for one pool",
     "mgr.pool_counts": "per-OSD pg/object/byte count reduction",
@@ -91,6 +94,8 @@ SPANS: dict[str, str] = {
                     "samples through the placement rows + contention "
                     "accounting",
     "bench.lifetime": "lifetime bench stage body",
+    "bench.multichip": "multichip bench: mesh-sharded map/lifetime/"
+                       "optimizer measurements for one device count",
     # serve/ — the placement serving daemon
     "serve.batch": "one micro-batch: deadline triage + device map + "
                    "reply delivery (host syncs allowed: the mapper "

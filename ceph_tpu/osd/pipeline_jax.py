@@ -526,7 +526,8 @@ class PoolMapper:
 
     def __init__(self, m: OSDMap, pool_id: int, overlays: bool = True,
                  path: str = "auto", chunk: int | None = DEFAULT_CHUNK,
-                 window_extra: int = FAST_WINDOW_EXTRA, state=None):
+                 window_extra: int = FAST_WINDOW_EXTRA, state=None,
+                 mesh=None):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
@@ -534,6 +535,13 @@ class PoolMapper:
         self.pool_id = pool_id
         self.window_extra = window_extra
         self._state = state
+        # PG-axis device mesh (jax.sharding.Mesh): block inputs commit
+        # to a NamedSharding over it and GSPMD partitions the SAME
+        # compiled pipeline — per-map operands ride replicated (see
+        # ceph_tpu.parallel.sharded).  Inherited from a shared
+        # ClusterState so every consumer of one state shards alike.
+        self.mesh = mesh if mesh is not None \
+            else getattr(state, "mesh", None)
         ca_key = pool_id if pool_id in m.crush.choose_args else -1
         ca = m.crush.choose_args.get(pool_id, m.crush.choose_args.get(-1))
         self._ca_key = ca_key if ca is not None else None
@@ -590,6 +598,38 @@ class PoolMapper:
         self._jdiag = None
         self.chunk = chunk
 
+    def shard_rows(self, rows):
+        """Re-commit [pg, lane] result rows to the mesh (PG axis
+        sharded) when one is configured and the shape divides — eager
+        tail ops (the [:n] slice, rescue/fixup scatters) can fall back
+        to a replicated layout, and the downstream reductions (epoch
+        stats, histograms, membership queries) should stay partitioned.
+        Bit-identical either way; this is layout only."""
+        if self.mesh is None \
+                or rows.shape[0] % self.mesh.devices.size:
+            return rows
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            rows,
+            NamedSharding(self.mesh, P(self.mesh.axis_names[0], None)),
+        )
+
+    def _shard_ps(self, ps):
+        """Commit a PG-axis block to the mesh when one is configured and
+        the block divides evenly (cycle-padded blocks always do); the
+        jitted executables then run GSPMD-partitioned over the PG axis.
+        No mesh (or an uneven tail) dispatches exactly as before."""
+        arr = jnp.asarray(ps, np.uint32)
+        if self.mesh is not None \
+                and arr.shape[0] % self.mesh.devices.size == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            arr = jax.device_put(
+                arr, NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            )
+        return arr
+
     def refresh_dev(self) -> None:
         """(Re)build the padded per-OSD vectors from the map's current
         osd state/weight/affinity — cheap O(OSDs) work, so callers that
@@ -597,7 +637,9 @@ class PoolMapper:
         round cache) can refresh instead of recompiling.  The CRUSH
         operand tables (device-put once at construction) ride along in
         dev["crush"].  State-shared mappers rebind the ClusterState's
-        scatter-maintained vectors instead of re-uploading anything."""
+        scatter-maintained vectors instead of re-uploading anything.
+        With a mesh, operands commit replicated across it (a no-op for
+        leaves the shared state already replicated)."""
         if self._state is not None:
             vec = self._state.vectors
             self.dev = {
@@ -614,6 +656,7 @@ class PoolMapper:
             }
             if self._tables_dev is not None:
                 self.dev["crush"] = self._tables_dev
+            self._replicate_dev()
             return
         dv = self.m.frozen_vectors()
         DV = max(self.arrays.max_devices, self.m.max_osd, 1)
@@ -635,6 +678,19 @@ class PoolMapper:
         }
         if self._tables_dev is not None:
             self.dev["crush"] = self._tables_dev
+        self._replicate_dev()
+
+    def _replicate_dev(self) -> None:
+        """With a mesh: commit the whole operand pytree replicated over
+        it, once — leaves already committed to the right sharding (the
+        shared ClusterState's vectors/tables) are no-ops, so the per-
+        dispatch cost of sharded mapping is zero host->device traffic."""
+        if self.mesh is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.dev = jax.device_put(
+            self.dev, NamedSharding(self.mesh, P()))
 
     def _cached_jit(self, kind: str, fn):
         acct = self._cache.get(kind)
@@ -876,10 +932,9 @@ class PoolMapper:
         # traced values inside them.  The unresolved-flag fetch sits
         # between the spans; result rows stay on device (rescued lanes
         # scattered in with .at[].set) until pipeline.fetch.
+        psd = self._shard_ps(ps)
         with obs.span("pipeline.map_block", pgs=n):
-            *out, flg = self.jitted_fast()(
-                jnp.asarray(ps, np.uint32), self.dev, self._ov_rows(ps)
-            )
+            *out, flg = self.jitted_fast()(psd, self.dev, self._ov_rows(ps))
         flg = obs.timed_fetch(_L, "result", flg)
         _L.inc("pgs_mapped", n)
         if flg.any():
@@ -936,7 +991,7 @@ class PoolMapper:
         vfast = self.jitted_fast()
         ups, flgs = [], []
         for i in range(nb):
-            ps = jnp.asarray(
+            ps = self._shard_ps(
                 (np.arange(i * B, (i + 1) * B) % n).astype(np.uint32)
             )
             with obs.span("pipeline.map_block", pgs=B, device_resident=True):
@@ -971,7 +1026,7 @@ class PoolMapper:
                         # write identical rows (no per-length retrace)
                         rows = rows.at[jnp.asarray(pad)].set(up)
             _L.inc("unresolved_pgs", n_unres)
-        return rows
+        return self.shard_rows(rows)
 
 
 def overlay_fixup_rows(m: OSDMap, pool_id: int, width: int):

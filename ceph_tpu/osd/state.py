@@ -267,12 +267,22 @@ class ClusterState:
       immutable device table (serve double-buffered staging).
     """
 
-    def __init__(self, m: OSDMap, chunk: int | None = None):
+    def __init__(self, m: OSDMap, chunk: int | None = None, mesh=None):
         from ceph_tpu.utils import ensure_jax_backend
 
         ensure_jax_backend()
         self.m = m
         self.chunk = chunk
+        # PG-axis device mesh: None = resolve from the
+        # CEPH_TPU_MESH_DEVICES knob (parallel.sharded.default_mesh) —
+        # ONE env var shards every consumer of this state (mapper rows,
+        # balancer membership, mgr eval, lifetime accounting, serve
+        # staging); per-OSD vectors and CRUSH tables replicate across it
+        if mesh is None:
+            from ceph_tpu.parallel.sharded import default_mesh
+
+            mesh = default_mesh()
+        self.mesh = mesh
         self.delta_enabled = knobs.get("CEPH_TPU_STATE_DELTA", "1") != "0"
         self._vec_ver = 0
         self._raw_ver = 0
@@ -336,19 +346,40 @@ class ClusterState:
                 pad_devices=self.DV, quantize=True)
         return A
 
+    def _put_replicated(self, x):
+        """jnp.asarray, committed replicated across the mesh when one
+        is configured (operands must live on every mesh device so a
+        sharded dispatch moves zero host->device bytes)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
     def device_tables_for(self, ca_key, fast_fn) -> dict:
         """device_put one structure's operand tables once; keyed by the
         (choose_args group, CRUSH-rule structure) pair — the tables are
         rule-level data, so overlay-gate variants of one pool (serve's
         overlay-carrying mappers vs the overlay-free row mappers) share
-        one upload."""
+        one upload.  With a mesh the pytree replicates across it."""
         key = (ca_key, fast_fn.cache_key[-1])
         tabs = self._tables.get(key)
         if tabs is None:
+            import jax
+
             from ceph_tpu.crush.mapper_jax import device_tables
 
             host = fast_fn.host_tables
-            tabs = self._tables[key] = device_tables(host)
+            tabs = device_tables(host)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                tabs = jax.device_put(
+                    tabs, NamedSharding(self.mesh, P()))
+            self._tables[key] = tabs
             _L.inc("device_put_bytes", _tables_nbytes(host))
         return tabs
 
@@ -373,7 +404,7 @@ class ClusterState:
                 v = np.concatenate(
                     [v, np.full(DV - v.shape[0], fill, v.dtype)])
             _L.inc("device_put_bytes", int(v.nbytes))
-            return jnp.asarray(v[:DV])
+            return self._put_replicated(v[:DV])
 
         self.vectors = {
             "exists": pad(dv["exists"], False),
@@ -476,6 +507,7 @@ class ClusterState:
                     vl = np.resize(stacked[i:i + P],
                                    (P,) + stacked.shape[1:])
                     rows = rows.at[jnp.asarray(sd)].set(jnp.asarray(vl))
+                rows = pm.shard_rows(rows)
             self._rows[pid] = (tag, rows, skey)
         _L.inc("rows_remapped")
         return rows, skey, tag
@@ -693,7 +725,7 @@ class ClusterState:
             A2 = self._arrays.get(ca_key)
             if A2 is not None and "pos_weights" in tabs:
                 _L.inc("device_put_bytes", int(A2.pos_weights.nbytes))
-                tabs["pos_weights"] = jnp.asarray(A2.pos_weights)
+                tabs["pos_weights"] = self._put_replicated(A2.pos_weights)
         for pm in self._mappers.values():
             pm.arrays = self._arrays.get(self._ca_key(pm.pool_id),
                                          pm.arrays)
@@ -765,6 +797,7 @@ class ClusterState:
                              "ClusterState")
         new = ClusterState.__new__(ClusterState)
         new.chunk = self.chunk
+        new.mesh = self.mesh
         new.delta_enabled = self.delta_enabled
         new._pending_rebuild = False
         new.full_rebuilds = 0

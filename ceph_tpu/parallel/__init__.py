@@ -1,6 +1,9 @@
 from ceph_tpu.parallel.sharded import (
     ShardedClusterMapper,
+    default_mesh,
+    last_mesh_provenance,
     make_mesh,
 )
 
-__all__ = ["ShardedClusterMapper", "make_mesh"]
+__all__ = ["ShardedClusterMapper", "default_mesh",
+           "last_mesh_provenance", "make_mesh"]

@@ -2,16 +2,28 @@
 
 The reference scales batch placement by sharding pgid ranges over a thread
 pool (ParallelPGMapper, reference src/osd/OSDMapMapping.h:18-140) and merges
-per-shard results under a lock.  The TPU-native equivalent: shard the PG axis
-of the batched pipeline over a `jax.sharding.Mesh` with `shard_map`, keep the
-(small) map tensors replicated, and reduce the per-OSD statistics with
-`psum` over ICI — no locks, no merge pass, one XLA program.
+per-shard results under a lock.  The TPU-native equivalent: commit the PG
+axis of the batched pipeline's inputs to a `jax.sharding.Mesh` with
+`NamedSharding` and let GSPMD partition the SAME compiled executables the
+single-device path dispatches (`_PIPE_CACHE` entries; per-map tensors
+replicated) — no locks, no merge pass, one XLA program per structure.
 
-This module also carries the cluster "step" used for rebalancing: map every
-PG, histogram PGs/primaries per OSD (the stats of osdmaptool
---test-map-pgs, reference src/tools/osdmaptool.cc:696-754), and produce a
-crush-compat style multiplicative weight adjustment from the deviation — one
-iteration of the balancer's outer loop, fully on-device.
+This module owns the mesh itself:
+
+- `make_mesh(n)` — a 1-D mesh over the first n devices, with requested-vs-
+  actual provenance (`last_mesh_provenance()`): a mesh that silently came
+  up smaller than asked can never masquerade as a scaling run.
+- `default_mesh()` — the `CEPH_TPU_MESH_DEVICES` knob routed through
+  `make_mesh`; every production consumer (`osd.state.ClusterState`, the
+  balancer's `DeviceState`, mgr eval, the lifetime engine, serve staging)
+  resolves its mesh here, so one env var shards the whole pipeline.
+- sharding helpers (`pg_sharding` / `row_sharding` / `replicated`) shared
+  by the consumers above.
+
+`ShardedClusterMapper` is the multichip driver surface (dryrun + bench):
+it maps and reduces through the PoolMapper's OWN jitted fast/rescue
+executables — the production pipeline, not a parallel copy of it — so the
+MULTICHIP equality asserts cover exactly the kernels serving traffic.
 """
 
 from __future__ import annotations
@@ -21,28 +33,43 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ceph_tpu import obs
 from ceph_tpu.core import reduce
-from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.crush.mapper_jax import rescue_pad_for
 from ceph_tpu.osd.pipeline_jax import PoolMapper
+from ceph_tpu.utils import knobs
 
 PG_AXIS = "pg"
 
+_PL = obs.logger_for("pipeline")
 
-def _shard_map(f, mesh: Mesh, in_specs, out_specs):
-    """jax.shard_map moved out of jax.experimental at ~0.6; support both
-    spellings (the arg asserting replication also renamed:
-    check_vma <- check_rep)."""
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=False)
-    from jax.experimental.shard_map import shard_map as esm
+# requested-vs-actual record of the LAST make_mesh call (the BENCH/
+# MULTICHIP provenance surface): a degraded mesh — fewer devices than
+# asked — must be visible in every record built on top of it
+_MESH_PROV: dict = {}
 
-    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               check_rep=False)
+# default_mesh() cache, keyed by the knob's current value so tests that
+# monkeypatch the env observe the change
+_DEFAULT_MESH: dict = {}
 
 
-def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
+def pg_sharding(mesh: Mesh) -> NamedSharding:
+    """1-D arrays sharded over the PG axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """[pg, lane] row tensors: PG axis sharded, lanes replicated."""
+    return NamedSharding(mesh, P(mesh.axis_names[0], None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated operands (per-OSD vectors, CRUSH tables)."""
+    return NamedSharding(mesh, P())
+
+
+def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS,
+              allow_fewer: bool = False) -> Mesh:
     """1-D mesh over the first n devices; the PG axis shards over it.
 
     The backend is acquired through the runtime degradation ladder
@@ -52,38 +79,103 @@ def make_mesh(n_devices: int | None = None, axis: str = PG_AXIS) -> Mesh:
     group and `runtime.last_provenance()`, which multichip drivers embed
     in their MULTICHIP JSON.
 
+    allow_fewer: degrade to however many devices exist instead of
+    raising.  Either way `last_mesh_provenance()` records requested vs
+    actual, so a mesh that came up smaller than asked (the old silent
+    1-device fallback) is always visible to the caller and to BENCH
+    records built on it.
+
     (The placement workload has a single giant data axis — see SURVEY's
     parallelism inventory; there is no tensor/pipeline dimension to shard,
     so the mesh is 1-D by design.)
     """
-    from ceph_tpu import obs, runtime
+    from ceph_tpu import runtime
     from ceph_tpu.utils import ensure_jax_backend
 
     backend = ensure_jax_backend()
     devs = jax.devices()
+    requested = n_devices
     if n_devices is None:
         n_devices = len(devs)
     if len(devs) < n_devices:
-        raise RuntimeError(
-            f"need {n_devices} devices, have {len(devs)} "
-            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
-        )
+        if not allow_fewer:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+            )
+        n_devices = len(devs)
     prov = runtime.last_provenance() or {}
-    obs.instant("sharded.make_mesh", backend=backend, devices=n_devices,
+    _MESH_PROV.clear()
+    _MESH_PROV.update({
+        "backend": backend,
+        "requested": requested,
+        "actual": n_devices,
+        "available": len(devs),
+        "degraded": requested is not None and n_devices != requested,
+        "fallback_reason": prov.get("fallback_reason"),
+    })
+    obs.instant("sharded.make_mesh", backend=backend,
+                requested=requested, devices=n_devices,
                 fallback_reason=prov.get("fallback_reason"))
     return Mesh(np.array(devs[:n_devices]), (axis,))
 
 
+def last_mesh_provenance() -> dict:
+    """Requested-vs-actual record of the most recent make_mesh call
+    (empty before the first one)."""
+    return dict(_MESH_PROV)
+
+
+def default_mesh(axis: str = PG_AXIS) -> Mesh | None:
+    """The process-wide production mesh: CEPH_TPU_MESH_DEVICES routed
+    through make_mesh (None when the knob is unset/<=1 — single-device,
+    the default).  Degrades to the available device count with
+    provenance instead of raising, so a production path never crashes
+    on a mis-sized knob; `last_mesh_provenance()["degraded"]` says when
+    that happened."""
+    val = knobs.get("CEPH_TPU_MESH_DEVICES")
+    if not val:
+        return None
+    try:
+        n = int(val)
+    except ValueError:
+        # a mis-typed knob degrades to single-device (the documented
+        # contract), visibly rather than crashing every consumer
+        _MESH_PROV.clear()
+        _MESH_PROV.update({"requested": val, "actual": 1,
+                           "degraded": True,
+                           "fallback_reason": "unparseable knob"})
+        obs.instant("sharded.make_mesh", requested=val, devices=1,
+                    fallback_reason="unparseable knob")
+        return None
+    if n <= 1:
+        return None
+    key = (val, axis)
+    mesh = _DEFAULT_MESH.get(key)
+    if mesh is None:
+        mesh = _DEFAULT_MESH[key] = make_mesh(n, axis, allow_fewer=True)
+    return mesh
+
+
 def _hist(ids, n, extra_mask=None):
     """Per-OSD counts via scatter-add (the shared device reduction from
-    ceph_tpu.core.reduce; traceable inside the shard_map bodies below —
-    invalid lanes, ITEM_NONE pads and -1 no-primary markers, fall off
-    the end)."""
+    ceph_tpu.core.reduce; traceable inside other jits — bench's stats
+    kernels reuse it; invalid lanes, ITEM_NONE pads and -1 no-primary
+    markers, fall off the end)."""
     return reduce.osd_histogram(ids, n, extra_mask)
 
 
+# (pm.cache_key, pg_padded, DV, mesh size) -> jitted stats/step kernels
+# for ShardedClusterMapper — the same trace-once idiom as bench's
+# _BENCH_JITS: drivers whose maps share structure share the compile.
+_SHARD_JITS: dict = {}
+
+
 class ShardedClusterMapper:
-    """Batched pool mapping + cluster stats, sharded over a device mesh.
+    """Batched pool mapping + cluster stats over a device mesh, through
+    the PRODUCTION pipeline executables (PoolMapper's jitted fast/rescue
+    kernels out of `_PIPE_CACHE`) with only the tiny histogram/weight
+    reductions compiled here.
 
     Usage:
         mesh = make_mesh()
@@ -94,77 +186,44 @@ class ShardedClusterMapper:
 
     def __init__(self, m, pool_id: int, mesh: Mesh):
         self.mesh = mesh
-        self.pm = PoolMapper(m, pool_id, overlays=False)
+        self.pm = PoolMapper(m, pool_id, overlays=False, mesh=mesh)
         self.n_dev_total = mesh.devices.size
         self.DV = int(self.pm.dev["weight"].shape[0])
         self.pg_num = self.pm.spec.pg_num
-        # pad the PG axis to a multiple of the mesh size
+        # pad the PG axis to a multiple of the mesh size (cycle-pad:
+        # pad lanes duplicate early seeds and are masked out of stats)
         n = self.n_dev_total
         self.pg_padded = ((self.pg_num + n - 1) // n) * n
-        self._jit_map = None
-        self._jit_step = None
         # crush-weight target pinned at construction (rebalance_step)
-        self._target_w = jnp.asarray(self.pm.dev["weight"])
+        self._target_w = jax.device_put(
+            jnp.asarray(self.pm.dev["weight"]), replicated(mesh))
+        # pg_num rides in the key explicitly: pool_operands drops it
+        # from pm.cache_key, but the kernels below close over it (live
+        # mask, rebalance target) — same-structure pools with different
+        # pg counts must not share a stats/step kernel
+        key = (self.pm.cache_key, self.pg_num, self.pg_padded,
+               self.DV, n)
+        ent = _SHARD_JITS.get(key)
+        if ent is None:
+            ent = _SHARD_JITS[key] = self._build_kernels()
+        self._jit_stats, self._jit_step = ent
 
-    # -- sharded mapping + stats ------------------------------------------
-    def _build_map_fn(self):
-        fn, DV, pg_num = self.pm.fn, self.DV, self.pg_num
-        vf = jax.vmap(fn, in_axes=(0, None, 0))
-        axis = self.mesh.axis_names[0]
-
-        def local(ps, dev):
-            # the exact kernel's trailing with_raw output (pre-overlay
-            # descent row) is not sharded state — drop it here
-            up, upp, acting, actp = vf(ps, dev, {})[:4]
-            live = ps < pg_num  # padding rows don't count
-            hist = _hist(acting, DV, live[:, None])
-            phist = _hist(actp[:, None], DV, live[:, None])
-            fhist = _hist(acting[:, :1], DV, live[:, None])
-            hist = jax.lax.psum(hist, axis)
-            phist = jax.lax.psum(phist, axis)
-            fhist = jax.lax.psum(fhist, axis)
-            return up, upp, acting, actp, hist, phist, fhist
-
-        sm = _shard_map(
-            local,
-            self.mesh,
-            (P(axis), P()),
-            (P(axis), P(axis), P(axis), P(axis), P(), P(), P()),
-        )
-        return jax.jit(sm)
-
-    def _ps(self):
-        ps = np.arange(self.pg_padded, dtype=np.uint32)
-        sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
-        return jax.device_put(ps, sh)
-
-    def map_stats(self):
-        """Map all PGs; returns dict with per-PG mappings (device-sharded)
-        and replicated per-OSD histograms (count / primary / first)."""
-        if self._jit_map is None:
-            self._jit_map = self._build_map_fn()
-        up, upp, acting, actp, hist, phist, fhist = self._jit_map(
-            self._ps(), self.pm.dev
-        )
-        return {
-            "up": up, "up_primary": upp,
-            "acting": acting, "acting_primary": actp,
-            "pgs_per_osd": hist,
-            "primary_per_osd": phist,
-            "first_per_osd": fhist,
-        }
-
-    # -- one balancer iteration, fully on device ---------------------------
-    def _build_step_fn(self):
-        fn, DV, pg_num = self.pm.fn, self.DV, self.pg_num
+    def _build_kernels(self):
+        DV, pg_num, pg_padded = self.DV, self.pg_num, self.pg_padded
         R = self.pm.spec.size
-        vf = jax.vmap(fn, in_axes=(0, None, 0))
-        axis = self.mesh.axis_names[0]
 
-        def local(ps, dev, target_w):
-            _, _, acting, _ = vf(ps, dev, {})[:4]
-            live = ps < pg_num
-            hist = jax.lax.psum(_hist(acting, DV, live[:, None]), axis)
+        @jax.jit
+        def stats(acting, actp):
+            live = (jnp.arange(pg_padded) < pg_num)[:, None]
+            hist = reduce.osd_histogram(acting, DV, live)
+            phist = reduce.osd_histogram(actp[:, None], DV, live)
+            fhist = reduce.osd_histogram(acting[:, :1], DV, live)
+            return hist, phist, fhist
+
+        @jax.jit
+        def step(acting, weight, target_w):
+            live = (jnp.arange(pg_padded) < pg_num)[:, None]
+            hist = reduce.osd_histogram(acting, DV, live)
             # weight-proportional target (reference src/osd/OSDMap.cc:
             # 4707-4732 deviation build): target_i = pgs*R * w_i / sum(w)
             # computed from the FIXED crush weights (target_w), not the
@@ -173,7 +232,7 @@ class ShardedClusterMapper:
             # (reference pybind/mgr/balancer/module.py:1031 do_crush_compat)
             tw = target_w.astype(jnp.float32)
             target = (pg_num * R) * tw / jnp.maximum(jnp.sum(tw), 1.0)
-            w = dev["weight"].astype(jnp.float32)
+            w = weight.astype(jnp.float32)
             dev_f = hist.astype(jnp.float32) - target
             stddev = jnp.sqrt(
                 jnp.sum(dev_f * dev_f) / jnp.maximum(jnp.sum(tw > 0), 1)
@@ -189,23 +248,75 @@ class ShardedClusterMapper:
             ).astype(jnp.uint32)
             return new_w, stddev, hist
 
-        sm = _shard_map(
-            local,
-            self.mesh,
-            (P(axis), P(), P()),
-            (P(), P(), P()),
-        )
-        return jax.jit(sm)
+        jstats = obs.JitAccount(
+            stats, _PL, "shard_stats",
+            exec_record=obs.executables.register(
+                "bench", "shard_stats",
+                (self.pm.cache_key, pg_padded, DV), fn=stats))
+        jstep = obs.JitAccount(
+            step, _PL, "shard_step",
+            exec_record=obs.executables.register(
+                "bench", "shard_step",
+                (self.pm.cache_key, pg_padded, DV), fn=step))
+        return jstats, jstep
 
+    def _ps(self):
+        ps = (np.arange(self.pg_padded) % self.pg_num).astype(np.uint32)
+        return jax.device_put(ps, pg_sharding(self.mesh))
+
+    def _map_planes(self, dev):
+        """All four mapping planes for every PG, device-resident and
+        PG-sharded, through the production fast+rescue contract: the
+        fast-window kernel runs first, flagged lanes are recomputed
+        exactly through the loop kernel and scattered back — the same
+        executables PoolMapper.map_batch dispatches."""
+        ps = self._ps()
+        with obs.span("pipeline.map_block", pgs=self.pg_num,
+                      sharded=self.n_dev_total):
+            *out, flg = self.pm.jitted_fast()(ps, dev, {})
+        _PL.inc("pgs_mapped", self.pg_num)
+        flg = np.asarray(flg)
+        if flg.any():
+            idx = np.nonzero(flg)[0]
+            _PL.inc("unresolved_pgs", int((idx < self.pg_num).sum()))
+            _PL.inc("rescue_invocations")
+            jloop = self.pm.jitted_loop()
+            ps_np = np.asarray((np.arange(self.pg_padded) % self.pg_num)
+                               .astype(np.uint32))
+            with obs.span("pipeline.rescue", lanes=len(idx)):
+                Pp = rescue_pad_for(len(idx))
+                for i in range(0, len(idx), Pp):
+                    pad = np.resize(idx[i:i + Pp], Pp)
+                    sub = jloop(jnp.asarray(ps_np[pad]), dev, {})
+                    bidx = jnp.asarray(pad)
+                    out = [o.at[bidx].set(s)
+                           for o, s in zip(out, sub)]
+        return out
+
+    # -- sharded mapping + stats ------------------------------------------
+    def map_stats(self):
+        """Map all PGs; returns dict with per-PG mappings (device-sharded)
+        and replicated per-OSD histograms (count / primary / first)."""
+        up, upp, acting, actp = self._map_planes(self.pm.dev)[:4]
+        hist, phist, fhist = self._jit_stats(acting, actp)
+        return {
+            "up": up, "up_primary": upp,
+            "acting": acting, "acting_primary": actp,
+            "pgs_per_osd": hist,
+            "primary_per_osd": phist,
+            "first_per_osd": fhist,
+        }
+
+    # -- one balancer iteration, fully on device ---------------------------
     def rebalance_step(self, weights=None):
         """One balancer iteration: map→histogram→deviation→weight update.
         `weights` are the adjustment weights to map with (default: the
         map's current in-weights); the deviation target always comes from
         the initial weights captured at construction.
         Returns (new_weight u32[DV], stddev, pgs_per_osd)."""
-        if self._jit_step is None:
-            self._jit_step = self._build_step_fn()
         dev = dict(self.pm.dev)
         if weights is not None:
-            dev["weight"] = jnp.asarray(weights, jnp.uint32)
-        return self._jit_step(self._ps(), dev, self._target_w)
+            dev["weight"] = jax.device_put(
+                jnp.asarray(weights, jnp.uint32), replicated(self.mesh))
+        acting = self._map_planes(dev)[2]
+        return self._jit_step(acting, dev["weight"], self._target_w)

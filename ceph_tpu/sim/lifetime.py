@@ -524,11 +524,19 @@ class LifetimeSim:
 
     def __init__(self, scenario: Scenario | str | None = None,
                  backend: str = "jax",
-                 checkpoint: str | None = None, resume: bool = False):
+                 checkpoint: str | None = None, resume: bool = False,
+                 mesh=None):
         if isinstance(scenario, str) or scenario is None:
             scenario = Scenario.parse(scenario)
         self.scenario = scenario
         self.backend = backend
+        # PG-axis device mesh for the whole epoch loop: the shared
+        # ClusterState shards its rows over it (None = ClusterState
+        # resolves the CEPH_TPU_MESH_DEVICES knob itself), so chaos
+        # epochs exercise SHARDED mapping with the same SHA-256 replay
+        # digest as single-device — the reductions are exact-integer,
+        # so partitioning cannot move a digest bit
+        self.mesh = mesh
         self.steps = 0
         self.digest = hashlib.sha256(
             scenario.spec().encode()).hexdigest()
@@ -710,7 +718,8 @@ class LifetimeSim:
 
             try:
                 self.state = ClusterState(self.m,
-                                          chunk=self.scenario.chunk)
+                                          chunk=self.scenario.chunk,
+                                          mesh=self.mesh)
             except Exception as e:
                 if not faults.looks_like_device_loss(e):
                     raise

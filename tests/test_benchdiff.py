@@ -108,6 +108,11 @@ def test_seeded_regressions_flagged():
         # recovery data plane (v7, seeded in r11->r12): a queue losing
         # bytes is device/host disagreement — semantic, compared raw
         "lifetime.recovery.conservation_violations",  # 0 -> 3
+        # mesh-sharded placement (v8, seeded in mc-r13->mc-r14): the
+        # sharded lifetime digest stopped matching single-device — the
+        # bit-exactness contract itself, compared raw
+        "multichip.ok",                        # the wrapper verdict bit
+        "multichip.scaling.digest_match",      # True -> False
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -117,6 +122,10 @@ def test_seeded_regressions_flagged():
         "serve.request_p99_s",                 # serving tail x7.5
         "lifetime.workload.served_qps",        # pareto service -32%
         "lifetime.recovery.drain_gbps",        # drain rate -45%
+        # candidate-batched optimizer (v8, seeded in r13->r14):
+        # batching went inert — back to ~1 dispatch per change; same
+        # calibration, so it flags as a same-machine semantic slowdown
+        "balancer.dispatches_per_change",      # 0.1875 -> 1.0625
     } <= flagged
     # every flagged throughput/tail metric compared on the same-machine
     # calibration basis, not raw cross-container numbers
@@ -176,6 +185,30 @@ def test_recovery_workload_fixture_pair_v7():
         d["metric"].startswith(("lifetime.recovery.",
                                 "lifetime.workload."))
         for d in rep2["regressions"])
+
+
+def test_mesh_batch_fixture_pairs_v8():
+    """The v8 seeded pairs in isolation: the candidate-batched
+    optimizer going inert (r13->r14, dispatches/change 0.19 -> 1.06,
+    flagged normalized — same calibration, semantic slowdown) and the
+    sharded lifetime digest mismatch (mc-r13 -> mc-r14, the
+    bit-exactness bit, flagged raw)."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r13"], by["r14"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    assert "balancer.dispatches_per_change" in flagged
+    assert flagged["balancer.dispatches_per_change"]["normalized"]
+    rep2 = diff_series([by["mc-r13"], by["mc-r14"]])
+    flagged2 = {d["metric"]: d for d in rep2["regressions"]}
+    assert "multichip.scaling.digest_match" in flagged2
+    assert not flagged2["multichip.scaling.digest_match"]["normalized"]
+    # the healthy record alone extracts the full scaling shape
+    m = extract_metrics(by["mc-r13"].record)
+    assert m["multichip.scaling.devices"][0] == 8
+    assert m["multichip.scaling.digest_match"][0] == 1.0
+    assert m["multichip.scaling.eps_per_device"][2] is False  # raw
+    assert "multichip.dispatch_reduction_x" in m
 
 
 def test_healthy_calibrated_rounds_are_clean():

@@ -1,0 +1,276 @@
+"""Mesh-sharded production placement + candidate-batched optimizer.
+
+Tier-1 (small, 2 of the forced 8 CPU devices): the ClusterState rows a
+meshed state serves must be bit-identical to the unsharded state's and
+to the host oracle — across a value-only delta apply — and the
+candidate-batched calc_pg_upmaps must match the sequential optimizer's
+plan quality at equal max_deviation while booking FEWER scoring
+dispatches per accepted change (counter-proven).  The knob/provenance
+surface (CEPH_TPU_MESH_DEVICES -> default_mesh, requested-vs-actual
+recording in make_mesh) is pinned here too.
+
+The 8-device lifetime digest-identity run and at-scale scaling rides
+the slow tier (tier-1 wall budget).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu import obs
+from ceph_tpu.balancer import calc_pg_upmaps
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.osd.incremental import Incremental
+from ceph_tpu.osd.osdmap import build_hierarchical
+from ceph_tpu.osd.state import ClusterState
+from ceph_tpu.osd.types import PgId, PgPool, PoolType
+from ceph_tpu.parallel.sharded import (
+    default_mesh,
+    last_mesh_provenance,
+    make_mesh,
+)
+
+
+def hier(pg_num=96, n_host=4, per=4, size=3):
+    pool = PgPool(
+        type=PoolType.REPLICATED, size=size, crush_rule=0,
+        pg_num=pg_num, pgp_num=pg_num,
+    )
+    return build_hierarchical(n_host, per, n_rack=2, pool=pool)
+
+
+def skewed(pg_num=512, n_host=8, per=4, down=6, seed=5):
+    m = hier(pg_num=pg_num, n_host=n_host, per=per)
+    rng = np.random.default_rng(seed)
+    for o in rng.choice(n_host * per, down, replace=False):
+        m.osd_weight[int(o)] = int(0x10000 * 0.6)
+    return m
+
+
+def _bal_snap():
+    d = obs.perf_dump().get("balancer") or {}
+    return {k: int(d.get(k, 0)) for k in (
+        "changes_accepted", "changes_rejected", "candidate_batches",
+        "candidates_scored")}
+
+
+# -- mesh knob + provenance -------------------------------------------------
+
+class TestMeshKnob:
+    def test_default_mesh_none_when_unset(self, monkeypatch):
+        monkeypatch.delenv("CEPH_TPU_MESH_DEVICES", raising=False)
+        assert default_mesh() is None
+
+    def test_default_mesh_routes_knob(self, monkeypatch):
+        monkeypatch.setenv("CEPH_TPU_MESH_DEVICES", "2")
+        mesh = default_mesh()
+        assert mesh is not None and mesh.devices.size == 2
+        monkeypatch.setenv("CEPH_TPU_MESH_DEVICES", "1")
+        assert default_mesh() is None  # <=1 = single-device
+
+    def test_make_mesh_records_requested_vs_actual(self):
+        # more devices than the forced 8 exist: allow_fewer degrades
+        # WITH provenance — a shrunken mesh can't pose as a scaling run
+        mesh = make_mesh(64, allow_fewer=True)
+        prov = last_mesh_provenance()
+        assert mesh.devices.size == prov["actual"] <= 8
+        assert prov["requested"] == 64
+        assert prov["degraded"] is True
+        with pytest.raises(RuntimeError):
+            make_mesh(64)  # strict form still refuses
+        mesh2 = make_mesh(2)
+        prov2 = last_mesh_provenance()
+        assert mesh2.devices.size == 2
+        assert prov2 == {**prov2, "requested": 2, "actual": 2,
+                         "degraded": False}
+
+    def test_default_mesh_degrades_oversized_knob(self, monkeypatch):
+        monkeypatch.setenv("CEPH_TPU_MESH_DEVICES", "999")
+        mesh = default_mesh()
+        assert mesh is not None and mesh.devices.size <= 8
+        assert last_mesh_provenance()["degraded"] is True
+
+
+# -- sharded ClusterState == unsharded == oracle ----------------------------
+
+class TestShardedState:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        mesh = make_mesh(2)
+        return (ClusterState(hier(), mesh=mesh), ClusterState(hier()))
+
+    def test_rows_equal_and_oracle(self, pair):
+        cs_sh, cs = pair
+        r_sh, _, _ = cs_sh.rows(0)
+        r, _, _ = cs.rows(0)
+        a, b = np.asarray(r_sh), np.asarray(r)
+        assert np.array_equal(a, b)
+        # PG-sharded layout actually landed on the mesh
+        assert len(r_sh.sharding.device_set) == 2
+        m = cs_sh.m
+        for ps in range(0, 96, 7):
+            up, _, _, _ = m.pg_to_up_acting_osds(PgId(0, ps))
+            got = [int(o) for o in a[ps] if o != ITEM_NONE]
+            assert got == up, ps
+
+    def test_value_delta_apply_under_mesh(self, pair):
+        cs_sh, cs = pair
+        for st in pair:
+            inc = Incremental(epoch=st.m.epoch + 1)
+            inc.new_weight[3] = int(0x10000 * 0.7)
+            inc.new_state[7] = 4  # OSD_UP xor: mark osd.7 down
+            assert st.apply(inc) == "delta"
+        r_sh, _, t1 = cs_sh.rows(0)
+        r, _, _ = cs.rows(0)
+        assert np.array_equal(np.asarray(r_sh), np.asarray(r))
+        m = cs_sh.m
+        for ps in range(0, 96, 11):
+            up, _, _, _ = m.pg_to_up_acting_osds(PgId(0, ps))
+            got = [int(o) for o in np.asarray(r_sh)[ps]
+                   if o != ITEM_NONE]
+            assert got == up, ps
+        # tag-stable re-read does no device work
+        before = int((obs.perf_dump().get("state") or {})
+                     .get("rows_remapped", 0))
+        _, _, t2 = cs_sh.rows(0)
+        after = int((obs.perf_dump().get("state") or {})
+                    .get("rows_remapped", 0))
+        assert t1 == t2 and before == after
+
+    def test_mgr_eval_scores_identically(self, pair):
+        from ceph_tpu.mgr import MappingState, synthetic_pg_stats
+        from ceph_tpu.mgr.eval import calc_eval
+
+        cs_sh, cs = pair
+        stats = synthetic_pg_stats(cs_sh.m)
+        pe_sh = calc_eval(MappingState(cs_sh.m, stats, state=cs_sh))
+        pe = calc_eval(MappingState(cs.m, stats, state=cs))
+        assert pe_sh.score == pe.score
+        assert pe_sh.count_by_pool == pe.count_by_pool
+
+
+# -- candidate-batched optimizer --------------------------------------------
+
+class TestCandidateBatched:
+    def test_quality_matches_sequential_with_fewer_dispatches(self):
+        max_dev = 2
+        m1, m2 = skewed(), skewed()
+        s0 = _bal_snap()
+        r1 = calc_pg_upmaps(
+            m1, max_deviation=max_dev, max_iter=40, use_tpu=False,
+            rng=np.random.default_rng(42))
+        s1 = _bal_snap()
+        r2 = calc_pg_upmaps(
+            m2, max_deviation=max_dev, max_iter=40, use_tpu=False,
+            rng=np.random.default_rng(42), candidate_batch=16)
+        s2 = _bal_snap()
+        seq_acc = s1["changes_accepted"] - s0["changes_accepted"]
+        seq_rej = s1["changes_rejected"] - s0["changes_rejected"]
+        acc = s2["changes_accepted"] - s1["changes_accepted"]
+        batches = s2["candidate_batches"] - s1["candidate_batches"]
+        assert acc > 0 and batches > 0
+        assert s2["candidates_scored"] > s1["candidates_scored"]
+        # counter proof: strictly fewer scoring dispatches per accepted
+        # change than the sequential one-eval-per-change loop
+        seq_ratio = (seq_acc + seq_rej) / max(seq_acc, 1)
+        assert batches / acc < seq_ratio
+        assert batches < acc
+        # plan quality no worse at equal max_deviation (equal budget)
+        assert r2.max_deviation <= max(r1.max_deviation,
+                                       float(max_dev)) + 1e-6
+        # budget semantics match the sequential loop's
+        assert r2.num_changed <= 40
+        self._assert_valid(m2)
+
+    @staticmethod
+    def _assert_valid(m, pool_id=0):
+        pool = m.pools[pool_id]
+        for pg, items in m.pg_upmap_items.items():
+            assert pg.pool == pool_id and pg.seed < pool.pg_num
+            for frm, to in items:
+                assert 0 <= to < m.max_osd and m.exists(to)
+        for ps in range(pool.pg_num):
+            up, _, _, _ = m.pg_to_up_acting_osds(PgId(pool_id, ps))
+            real = [o for o in up if o != ITEM_NONE]
+            assert len(real) == len(set(real)) == pool.size, ps
+
+    def test_device_backend_scores_on_device(self):
+        """The jnp scoring kernel path (backend="device"): valid plan,
+        improvement, and the batch counters advance."""
+        m = skewed(pg_num=256, n_host=4, down=4)
+        s0 = _bal_snap()
+        r = calc_pg_upmaps(
+            m, max_deviation=1, max_iter=12,
+            rng=np.random.default_rng(7), backend="device",
+            candidate_batch=8)
+        s1 = _bal_snap()
+        assert s1["candidate_batches"] > s0["candidate_batches"]
+        if r.num_changed:
+            assert r.stddev >= 0
+            self._assert_valid(m)
+
+    def test_mgr_option_routes_candidate_batch(self):
+        from ceph_tpu.mgr import Balancer, MappingState, \
+            synthetic_pg_stats
+
+        m = skewed(pg_num=256, n_host=4, down=4, seed=9)
+        bal = Balancer(options={"upmap_max_optimizations": 8,
+                                "upmap_candidate_batch": 8},
+                       rng=np.random.default_rng(3))
+        ms = MappingState(m, synthetic_pg_stats(m), mapper="host")
+        plan = bal.plan_create("t", ms, mode="upmap")
+        s0 = _bal_snap()
+        rc, _ = bal.optimize(plan)
+        s1 = _bal_snap()
+        if rc == 0:
+            assert s1["candidate_batches"] > s0["candidate_batches"]
+
+
+# -- sharded lifetime digest identity (slow tier) ---------------------------
+
+MC_SCENARIO = (
+    "epochs=36,seed=11,hosts=4,osds_per_host=3,racks=2,pgs=64,ec=2+1,"
+    "ec_pgs=32,chunk=512,balance_every=12,balance_max=4,"
+    "spotcheck_every=12,checkpoint_every=0,recovery=flat,workload=0"
+)
+
+
+@pytest.mark.slow
+def test_sharded_lifetime_digest_identity():
+    """Chaos epochs on an 8-device mesh chain the SAME SHA-256 replay
+    digest as single-device — the reductions are exact-integer, so
+    GSPMD partitioning cannot move a digest bit — and steady epochs
+    still book 0 compiles under sharding."""
+    from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+    a = LifetimeSim(Scenario.parse(MC_SCENARIO), backend="jax",
+                    mesh=make_mesh(8)).run()
+    b = LifetimeSim(Scenario.parse(MC_SCENARIO), backend="jax").run()
+    assert a["digest"] == b["digest"]
+    assert a["invariant_violations"] == 0
+    assert a["trace_once"]["steady_compiles"] == 0
+
+
+@pytest.mark.slow
+def test_sharded_rebalance_at_scale():
+    """Candidate-batched device-backend optimizer on an 8-device mesh
+    at a bigger shape: valid plan, >=2x fewer dispatches per change."""
+    m1, m2 = skewed(pg_num=2048), skewed(pg_num=2048)
+    mesh = make_mesh(8)
+    s0 = _bal_snap()
+    calc_pg_upmaps(m1, max_deviation=2, max_iter=48,
+                   rng=np.random.default_rng(1), backend="device",
+                   mesh=mesh)
+    s1 = _bal_snap()
+    calc_pg_upmaps(m2, max_deviation=2, max_iter=48,
+                   rng=np.random.default_rng(1), backend="device",
+                   mesh=mesh, candidate_batch=32)
+    s2 = _bal_snap()
+    seq_acc = s1["changes_accepted"] - s0["changes_accepted"]
+    seq_rej = s1["changes_rejected"] - s0["changes_rejected"]
+    acc = s2["changes_accepted"] - s1["changes_accepted"]
+    batches = s2["candidate_batches"] - s1["candidate_batches"]
+    assert acc > 0
+    assert (seq_acc + seq_rej) / max(seq_acc, 1) \
+        >= 2 * (batches / max(acc, 1))

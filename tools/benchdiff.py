@@ -73,7 +73,18 @@ from pathlib import Path
 #     at-risk hits and backlog counts are seeded-deterministic —
 #     compared raw; served QPS and the observed backlog-drain rate
 #     are calibration-normalized hardware rates.
-SCHEMA_VERSION = 7
+# v8: mesh-sharded placement + candidate-batched optimizer.  MULTICHIP
+#     wrappers may carry a `scaling` record (`bench.py --multichip`):
+#     devices, eps/device, maps/s/device, steady compiles and the
+#     sharded-vs-single-device digest-match bit fold as
+#     `multichip.scaling.*` — all structural (the scenario is seeded
+#     and a digest mismatch or steady compile is semantic drift).  The
+#     BENCH `balancer` stage grows `dispatches_per_change` (the
+#     candidate-batched optimizer's scoring dispatches per accepted
+#     change; lower is better, calibration-normalized alongside the
+#     stage's wall times) and `seq_dispatches_per_change` for the
+#     same-run sequential baseline.
+SCHEMA_VERSION = 8
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -153,7 +164,11 @@ def _from_multichip(raw: dict) -> dict:
     diffed separately from the BENCH series (a multichip dry-run and a
     bench run share no metrics).  All structural: device counts, the
     sharded==unsharded verdict, and the rebalance stddev the dry-run
-    prints are deterministic, never hardware-scaled."""
+    prints are deterministic, never hardware-scaled.  v8 records
+    (`bench.py --multichip`) additionally carry a `scaling` record
+    (devices / eps per device / maps per device / steady compiles /
+    the digest-match bit) and the candidate-batched optimizer's
+    dispatch ratio — folded under the same trajectory."""
     mc: dict = {}
     nd = raw.get("n_devices")
     if isinstance(nd, (int, float)) and not isinstance(nd, bool):
@@ -164,6 +179,19 @@ def _from_multichip(raw: dict) -> dict:
     if m:
         mc["pgs"] = int(m.group(2))
         mc["stddev"] = float(m.group(3))
+    sc = raw.get("scaling")
+    if isinstance(sc, dict):
+        mc["scaling"] = {
+            k: sc.get(k)
+            for k in ("devices", "eps_per_device",
+                      "maps_per_sec_per_device", "steady_compiles",
+                      "digest_match")
+            if sc.get(k) is not None
+        }
+    bal = raw.get("balancer")
+    if isinstance(bal, dict) \
+            and bal.get("dispatch_reduction_x") is not None:
+        mc["dispatch_reduction_x"] = bal["dispatch_reduction_x"]
     return {"multichip": mc} if mc else {}
 
 
@@ -274,6 +302,13 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
             mrec.get("eval_pgs_per_sec"), True, True)
         put(f"balancer.{mode}.jit.compiles",
             (mrec.get("jit") or {}).get("compiles"), False, False)
+    # candidate-batched optimizer (v8): scoring dispatches per accepted
+    # change — the batched path's headline ratio vs its same-run
+    # sequential baseline (both lower-is-better)
+    put("balancer.dispatches_per_change",
+        bal.get("dispatches_per_change"), False, True)
+    put("balancer.seq_dispatches_per_change",
+        bal.get("seq_dispatches_per_change"), False, True)
     rb = rec.get("rebalance") or rec.get("rebalance_10m_10k") or {}
     put("rebalance.build_s", rb.get("build_s"), False, True)
     rounds = rb.get("rounds") or []
@@ -406,6 +441,23 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
     put("multichip.stddev", mc.get("stddev"), False, False)
     if isinstance(mc.get("ok"), bool):
         out["multichip.ok"] = (float(mc["ok"]), True, False)
+    # mesh-scaling record (v8): all structural — the scenario is
+    # seeded, so eps/device movement at equal devices, a steady-epoch
+    # compile, or a sharded-vs-single-device digest mismatch is
+    # semantic drift, never hardware variance
+    msc = mc.get("scaling") or {}
+    put("multichip.scaling.devices", msc.get("devices"), True, False)
+    put("multichip.scaling.eps_per_device",
+        msc.get("eps_per_device"), True, False)
+    put("multichip.scaling.maps_per_sec_per_device",
+        msc.get("maps_per_sec_per_device"), True, False)
+    put("multichip.scaling.steady_compiles",
+        msc.get("steady_compiles"), False, False)
+    if isinstance(msc.get("digest_match"), bool):
+        out["multichip.scaling.digest_match"] = (
+            float(msc["digest_match"]), True, False)
+    put("multichip.dispatch_reduction_x",
+        mc.get("dispatch_reduction_x"), True, False)
     return out
 
 
