@@ -869,6 +869,9 @@ def bench_serve(h) -> dict:
                                          0.050))
     cfg = ServeConfig(block=block, fill=4 * block, max_queue=64,
                       deadline_s=2.0, degraded_batches=2)
+    # stage-local health story: the lifetime stage's raised checks
+    # belong to ITS record; serve starts from a clean registry
+    obs.health.reset()
     m = build_map(pgs, osds)
     svc = PlacementService(m, config=cfg, name="bench.serve")
     res: dict = {"pgs": pgs, "osds": osds, "block": block,
@@ -1012,17 +1015,35 @@ def bench_serve(h) -> dict:
     # generous deadline: on a throttled container the sim's epoch work
     # and structural-swap tracing hold the GIL for seconds at a time —
     # exactly the control-plane/client contention being measured
-    chaos = run_chaos(
-        epochs=chaos_epochs,
-        config=ServeConfig(block=256, fill=1024, max_queue=64,
-                           deadline_s=10.0),
-        clients=2, client_batch=128,
-    )
+    # a bounded run of stalled dispatches early in the chaos window
+    # blows the windowed p99 past the SLO objective: the burn must
+    # RAISE SLO_BURN, and once the stalls exhaust, a fast window of
+    # clean samples must CLEAR it — the raise->clear transition rides
+    # the serve timeline across structural swaps, and dropped stays 0
+    # (a stalled batch still answers; stall < deadline)
+    faults.arm("serve_dispatch", "stall", "0.4", 8)
+    try:
+        chaos = run_chaos(
+            epochs=chaos_epochs,
+            config=ServeConfig(block=256, fill=1024, max_queue=64,
+                               deadline_s=10.0),
+            clients=2, client_batch=128,
+        )
+    finally:
+        faults.disarm("serve_dispatch")
     res["chaos"] = {k: chaos.get(k) for k in (
         "epochs", "qps", "p50_s", "p99_s", "dropped", "swaps_ok",
         "swaps_rejected", "swap_stall_p99_s", "queries_shed",
         "queries_expired", "sim_violations", "degraded_reads_served",
         "at_risk_hits", "recovery_backlog_gb")}
+    # health / SLO / timeline (schema v9): the burn-rate engine's
+    # transition counts, the summarized end-of-stage status, and the
+    # serve-timeline sample count
+    res["slo"] = chaos.get("slo")
+    res["health"] = (chaos.get("health") or {}).get("status")
+    res["health_checks"] = sorted(
+        (chaos.get("health") or {}).get("checks") or ())
+    res["timeline_samples"] = chaos.get("timeline_samples")
     res["jit"] = _jit_delta(jit0)
     return res
 
@@ -1109,6 +1130,35 @@ def bench_lifetime(h) -> dict:
     ck.unlink(missing_ok=True)
     ck2.unlink(missing_ok=True)
 
+    # pure-observer proof (schema v9): a slice of the same scenario
+    # with the health model and timeline recorder DISABLED must land on
+    # the same replay digest with the same steady-epoch compile count —
+    # the observers may read the accounting, never steer it
+    sc_p = Scenario.parse(spec)
+    sc_p.epochs = min(24, epochs)
+    purity = []
+    for off in (False, True):
+        overrides = ({"CEPH_TPU_HEALTH": "0",
+                      "CEPH_TPU_TIMELINE_CAP": "0"} if off else {})
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            with obs.span("bench.lifetime", phase="purity",
+                          observers=not off, epochs=sc_p.epochs):
+                out_p = LifetimeSim(sc_p, backend="jax").run()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        purity.append({"observers": not off, "digest": out_p["digest"],
+                       "steady_compiles":
+                       out_p["trace_once"]["steady_compiles"]})
+    health_pure = (purity[0]["digest"] == purity[1]["digest"]
+                   and purity[0]["steady_compiles"]
+                   == purity[1]["steady_compiles"])
+
     tr = out_a["trace_once"]
     # the ClusterState O(delta) proofs: whole-run apply classification
     # and the balancer's membership builds served from the shared rows
@@ -1151,12 +1201,25 @@ def bench_lifetime(h) -> dict:
             if out_a.get("wall_s") else 0.0),
         "workload": out_a.get("workload"),
         "pareto": out_a.get("pareto"),
+        # cluster health model + timeline flight recorder (schema v9):
+        # summarized status, per-epoch ok/warn/err split, the raised
+        # checks, and the sim-timeline sample count — plus the
+        # pure-observer proof (digest and compiles invariant under
+        # CEPH_TPU_HEALTH=0 CEPH_TPU_TIMELINE_CAP=0)
+        "health": out_a.get("health"),
+        "health_pure": health_pure,
+        "health_purity": purity,
         # robustness proofs
         "device_loss_fallbacks":
             out_a["provenance"]["device_loss_fallbacks"],
         "device_loss_epoch": loss_epoch,
         "resume_from": out_b.get("resumed_from"),
         "resume_digest_match": out_b["digest"] == out_a["digest"],
+        # timeline survives the checkpoint round-trip: the resumed
+        # engine restores the recorder and keeps the SAME monotonic
+        # sample index, so its final count equals the straight run's
+        "resume_timeline_samples":
+            (out_b.get("health") or {}).get("timeline_samples"),
         "jit": _jit_delta(jit0),
     }
 
@@ -1938,6 +2001,13 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
             "benchdiff did not flag the candidate-batched optimizer "
             "regression seeded in the fixture series (schema v8 "
             "balancer.dispatches_per_change not folded)")
+    elif not any(d["metric"].startswith(("lifetime.health",
+                                         "serve.slo."))
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the health/SLO regression seeded "
+            "in the fixture series (schema v9 health/slo metrics not "
+            "folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -2052,6 +2122,31 @@ def selftest() -> int:
         if not lf.get("resume_digest_match"):
             problems.append(
                 "lifetime resume digest != straight-run digest")
+        # health model acceptance gates (schema v9): the chaos
+        # scenario must trip real checks, the observers must be
+        # provably free, and the timeline must survive the resume
+        # round-trip with its monotonic sample index intact
+        hl = lf.get("health") or {}
+        hep = hl.get("epochs") or {}
+        if not (hep.get("warn", 0) + hep.get("err", 0)) > 0:
+            problems.append(
+                "lifetime chaos scenario recorded no non-OK health "
+                "epoch (health model inert through device deaths and "
+                "degraded PGs)")
+        if not lf.get("health_pure"):
+            problems.append(
+                f"health/timeline observers are not pure: digests or "
+                f"steady compiles moved under CEPH_TPU_HEALTH=0 "
+                f"({lf.get('health_purity')})")
+        if not hl.get("timeline_samples", 0) > 0:
+            problems.append("sim timeline recorded no samples")
+        elif lf.get("resume_timeline_samples") \
+                != hl.get("timeline_samples"):
+            problems.append(
+                "timeline did not survive checkpoint resume with a "
+                f"continuous sample index (straight "
+                f"{hl.get('timeline_samples')} != resumed "
+                f"{lf.get('resume_timeline_samples')})")
         # recovery data plane + workload acceptance gates: the queue
         # conserved every byte, a real backlog was observed (the flat
         # model's silent floor would show 0), and the pareto headline
@@ -2132,6 +2227,20 @@ def selftest() -> int:
                 f"serve chaos dropped {cz.get('dropped')} queries")
         if not cz.get("swaps_ok", 0) > 0:
             problems.append("serve chaos applied no epoch swaps")
+        # SLO burn-rate acceptance gate (schema v9): the injected
+        # dispatch stalls must RAISE the burn, the post-fault clean
+        # windows must CLEAR it, and none of it may drop a query
+        slo = sv.get("slo") or {}
+        if not slo.get("burns_raised", 0) >= 1:
+            problems.append(
+                "serve chaos raised no SLO burn despite injected "
+                "dispatch stalls (burn-rate engine inert)")
+        elif not slo.get("burns_cleared", 0) >= 1:
+            problems.append(
+                "serve chaos SLO burn never cleared after the stalls "
+                "exhausted (clear path inert)")
+        if not sv.get("timeline_samples", 0) > 0:
+            problems.append("serve timeline recorded no samples")
         # candidate-batched optimizer gate: the balancer stage must
         # record the dispatches-per-change pair, and batching may never
         # cost MORE scoring dispatches per accepted change than the
@@ -2178,7 +2287,8 @@ def selftest() -> int:
                      "device_loss_fallbacks", "resume_digest_match",
                      "epochs_per_sec", "cluster_years_per_hour",
                      "degraded_epochs", "recovery", "workload",
-                     "pareto")
+                     "pareto", "health", "health_pure",
+                     "resume_timeline_samples")
         } or None,
         "serve": {
             k: v for k, v in (out.get("serve") or {}).items()
@@ -2188,7 +2298,7 @@ def selftest() -> int:
                      "swap_full_restages", "swap_state_rebuilds",
                      "swap_prepare_avg_s", "burst_shed",
                      "degraded_answered", "device_loss_recovered",
-                     "chaos")
+                     "chaos", "slo", "health", "timeline_samples")
         } or None,
         "balancer": {
             k: v for k, v in (out.get("balancer") or {}).items()

@@ -31,6 +31,10 @@ Commands (reference names):
     serve status  live placement-service status (ceph_tpu.serve:
                   epoch, queue depth, shed/degraded counters,
                   swap-stall tail)
+    health        summarized HEALTH_OK/WARN/ERR + raised checks
+                  (ceph_tpu.obs.health; the `ceph status` analogue)
+    timeline dump every recorded timeline series (obs/timeline.py),
+                  both retention tiers, chronological
     help          command list
 
 The in-process self-test pins JAX to CPU (it is a diagnostic path — it

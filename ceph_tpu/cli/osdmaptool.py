@@ -129,6 +129,38 @@ def _map_pool(m: OSDMap, pool_id: int, backend: str):
     return acting, actp, up, upp
 
 
+def map_health(m: OSDMap, backend: str = "jax") -> dict:
+    """Evaluate the obs/health checks against a loaded map: OSD
+    exists/up state plus per-PG live-mapping occupancy vs the pool's
+    size (degraded), min_size (at risk) and zero (unmapped)."""
+    from ceph_tpu.obs import health
+
+    exists = down = 0
+    for o in range(m.max_osd):
+        if m.exists(o):
+            exists += 1
+            if m.is_down(o):
+                down += 1
+    degraded = unmapped = at_risk = 0
+    for pid in sorted(m.pools):
+        pool = m.pools[pid]
+        acting, _actp, _up, _upp = _map_pool(m, pid, backend)
+        for ps in range(pool.pg_num):
+            live = sum(1 for o in acting[ps]
+                       if o != ITEM_NONE and m.is_up(o))
+            if live == 0:
+                unmapped += 1
+                continue
+            if live < pool.size:
+                degraded += 1
+            if live < pool.min_size:
+                at_risk += 1
+    health.reset()  # this tool reports THIS map, not process history
+    health.evaluate(osds_down=down, osd_count=exists, degraded=degraded,
+                    unmapped=unmapped, at_risk=at_risk)
+    return health.dump()
+
+
 def test_map_pgs(
     m: OSDMap,
     only_pool: int = -1,
@@ -318,6 +350,7 @@ def main(argv: list[str] | None = None) -> int:
     default_pool_size: int | None = None
     aggressive = True  # osd_calc_pg_upmaps_aggressively default
     marked_in = -1
+    do_health = False
 
     p = _Args(args)
     while not p.done():
@@ -356,6 +389,8 @@ def main(argv: list[str] | None = None) -> int:
             marked_up = v
         elif (v := p.withint("--mark-in")) is not None:
             marked_in = v
+        elif p.flag("--health"):
+            do_health = True
         elif p.flag("--test-map-pgs"):
             test_map_pgs_mode = "stats"
         elif p.flag("--test-map-pgs-dump"):
@@ -694,11 +729,18 @@ def main(argv: list[str] | None = None) -> int:
             backend=backend,
         )
 
+    health_rc = 0
+    if do_health:
+        h = map_health(m, backend=backend)
+        print(json.dumps(h, indent=1, sort_keys=True))
+        if h["status"] != "HEALTH_OK":
+            health_rc = 1
+
     no_action = not (
         do_print or tree or modified or write_out or export_crush
         or import_crush or test_map_pg or test_map_object
         or test_map_pgs_mode or adjust_crush_weight or upmap
-        or upmap_cleanup
+        or upmap_cleanup or do_health
     )
     if no_action:
         print(f"{ME}: no action specified?", file=sys.stderr)
@@ -737,7 +779,7 @@ def main(argv: list[str] | None = None) -> int:
             m.wire["modified"] = _now_utime()
         print(f"{ME}: writing epoch {m.epoch} to {fn}")
         save_osdmap(m, fn)
-    return 0
+    return health_rc
 
 
 if __name__ == "__main__":

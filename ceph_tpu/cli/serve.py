@@ -154,6 +154,17 @@ def _emit(args, out: dict) -> int:
                   "degraded_answered", "sim_digest"):
             if k in out and out[k] is not None:
                 print(f"{k:20} {out[k]}")
+        slo = out.get("slo")
+        if slo:
+            print(f"{'slo':20} burning={slo['burning']} "
+                  f"raised={slo['burns_raised']} "
+                  f"cleared={slo['burns_cleared']} "
+                  f"burn_minutes={slo['burn_minutes']} "
+                  f"breaches={slo['breaches']}/{slo['samples']}")
+        h = out.get("health")
+        if h:
+            codes = ",".join(sorted(h.get("checks") or ())) or "-"
+            print(f"{'health':20} {h['status']} ({codes})")
     return 1 if out.get("dropped") else 0
 
 

@@ -94,6 +94,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"events          {out['events']}")
         print(f"movement        {out['report']}")
         print(f"degraded epochs {out['degraded_epochs']}")
+        h = out.get("health")
+        if h:
+            ep = h.get("epochs") or {}
+            codes = ",".join(sorted(h.get("checks") or ())) or "-"
+            print(f"health          {h['status']} (epochs: "
+                  f"{ep.get('ok', 0)} ok / {ep.get('warn', 0)} warn / "
+                  f"{ep.get('err', 0)} err; raised: {codes}; "
+                  f"{h.get('timeline_samples', 0)} timeline samples)")
         rec = out.get("recovery")
         if rec:
             print(f"recovery        queue: {rec['enqueued_gb']} GB "

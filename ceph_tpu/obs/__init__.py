@@ -29,6 +29,7 @@ Importing this package is cheap (no jax import) and, when
 from __future__ import annotations
 
 from ceph_tpu.obs import executables, placement, quantiles, spans, trace
+from ceph_tpu.obs import health, timeline  # noqa: E402 (need trace first)
 from ceph_tpu.obs.admin_socket import maybe_start_from_env
 from ceph_tpu.obs.jax_accounting import JitAccount, timed_fetch
 from ceph_tpu.obs.trace import (
@@ -51,11 +52,13 @@ from ceph_tpu.utils.perf_counters import (
 def prometheus_text() -> str:
     """Prometheus text exposition of the whole perf registry, plus the
     executable-registry gauges (per-cache entry counts, compile seconds,
-    dispatch totals) and the placement-diagnostics per-source gauges."""
+    dispatch totals), the placement-diagnostics per-source gauges, the
+    health-check gauges, and the timeline latest-sample gauges."""
     from ceph_tpu.obs.prometheus import prometheus_text as _render
 
     return (_render(perf_dump()) + executables.prometheus_gauges()
-            + placement.prometheus_gauges())
+            + placement.prometheus_gauges() + health.prometheus_gauges()
+            + timeline.prometheus_gauges())
 
 
 def jit_counters() -> dict:
@@ -95,6 +98,7 @@ __all__ = [
     "counter",
     "executables",
     "flush",
+    "health",
     "instant",
     "jit_counters",
     "jit_counters_delta",
@@ -109,6 +113,7 @@ __all__ = [
     "span",
     "spans",
     "timed_fetch",
+    "timeline",
     "trace",
     "trace_path",
 ]

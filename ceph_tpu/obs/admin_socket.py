@@ -28,6 +28,10 @@ protocol here:
     client: "serve status\\n"   server: live placement-service status
                                 (epoch, queue depth, shed/degraded
                                 counters, swap-stall tail) per service
+    client: "health\\n"         server: summarized HEALTH_OK/WARN/ERR +
+                                raised checks (ceph_tpu.obs.health)
+    client: "timeline dump\\n"  server: every recorded timeline series,
+                                both retention tiers, chronological
     client: "help\\n"           server: command list JSON
 
 Env-gated like tracing: set `CEPH_TPU_ADMIN_SOCKET=/path/x.asok` and any
@@ -53,7 +57,7 @@ _server: "AdminSocket | None" = None
 COMMANDS = (
     "perf dump", "perf schema", "perf reset", "metrics", "cache dump",
     "bad dump", "explain <pool>.<seed>", "trace flush", "runtime",
-    "serve status", "help",
+    "serve status", "health", "timeline dump", "help",
 )
 
 # concurrent per-connection handler threads (beyond this, accepts wait):
@@ -126,6 +130,17 @@ def handle_command(cmd: str) -> str:
 
         return json.dumps(serve_service.status_dump(), indent=1,
                           sort_keys=True)
+    if cmd == "health":
+        # the `ceph status` analogue: summarized status + raised checks
+        from ceph_tpu.obs import health
+
+        return json.dumps(health.dump(), indent=1, sort_keys=True)
+    if cmd == "timeline dump":
+        # the flight recorder: every recorded series, both tiers,
+        # chronological
+        from ceph_tpu.obs import timeline
+
+        return json.dumps(timeline.dump(), indent=1, sort_keys=True)
     if cmd == "help":
         return json.dumps(list(COMMANDS))
     return json.dumps({"error": f"unknown command {cmd!r}", "help": list(COMMANDS)})

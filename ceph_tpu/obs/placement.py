@@ -162,12 +162,11 @@ def explain(pgid: str) -> dict:
 
 
 def _esc(label: str) -> str:
-    """Prometheus label-value escaping (`\\`, `"`, newline) — sources
-    embed user-chosen plan names, unlike the internal-constant labels
-    elsewhere in obs."""
-    return (label.replace("\\", "\\\\")
-                 .replace('"', '\\"')
-                 .replace("\n", "\\n"))
+    """Prometheus label-value escaping — sources embed user-chosen plan
+    names, unlike the internal-constant labels elsewhere in obs."""
+    from ceph_tpu.obs.prometheus import escape_label
+
+    return escape_label(label)
 
 
 def prometheus_gauges() -> str:

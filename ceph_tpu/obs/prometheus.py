@@ -29,6 +29,16 @@ def _name(group: str, key: str) -> str:
     return _BAD.sub("_", f"ceph_tpu_{group}_{key}")
 
 
+def escape_label(label: str) -> str:
+    """Prometheus label-value escaping (`\\`, `"`, newline).  Any gauge
+    whose label embeds an operator- or user-chosen string (plan names,
+    health summaries, timeline series) must route through this — raw
+    interpolation corrupts the exposition on the first quote."""
+    return (label.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
 def _fmt(v: float) -> str:
     if isinstance(v, float) and v != v:  # NaN
         return "NaN"

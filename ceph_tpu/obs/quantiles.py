@@ -37,6 +37,27 @@ DEFAULT_BOUNDS: tuple[float, ...] = tuple(
 REPORTED = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
 
 
+def _interpolate(bounds, i: int, cum: float, n: float, rank: float,
+                 vmin: float | None, vmax: float | None) -> float:
+    """Position of `rank` inside landing bucket i (see module doc)."""
+    if i == 0:
+        lo = vmin if vmin is not None else bounds[0] / 10.0
+        hi = bounds[0]
+    elif i == len(bounds):
+        lo = bounds[-1]
+        hi = vmax if vmax is not None else bounds[-1] * 10.0
+    else:
+        lo, hi = bounds[i - 1], bounds[i]
+    if vmin is not None:
+        lo = max(lo, min(vmin, hi))
+    if vmax is not None:
+        hi = min(hi, max(vmax, lo))
+    frac = (rank - cum) / n
+    if lo > 0 and hi > lo:
+        return lo * (hi / lo) ** frac  # log-linear: see module doc
+    return lo + (hi - lo) * frac
+
+
 def estimate(
     bounds, buckets, q: float,
     vmin: float | None = None, vmax: float | None = None,
@@ -57,31 +78,39 @@ def estimate(
         if n == 0:
             continue
         if cum + n >= rank:
-            # edges of the landing bucket
-            if i == 0:
-                lo = vmin if vmin is not None else bounds[0] / 10.0
-                hi = bounds[0]
-            elif i == len(bounds):
-                lo = bounds[-1]
-                hi = vmax if vmax is not None else bounds[-1] * 10.0
-            else:
-                lo, hi = bounds[i - 1], bounds[i]
-            if vmin is not None:
-                lo = max(lo, min(vmin, hi))
-            if vmax is not None:
-                hi = min(hi, max(vmax, lo))
-            frac = (rank - cum) / n
-            if lo > 0 and hi > lo:
-                return lo * (hi / lo) ** frac  # log-linear: see module doc
-            return lo + (hi - lo) * frac
+            return _interpolate(bounds, i, cum, n, rank, vmin, vmax)
         cum += n
     # rank beyond the last populated bucket (fp rounding): the maximum
     return vmax if vmax is not None else (bounds[-1] if bounds else 0.0)
 
 
 def summarize(bounds, buckets, vmin=None, vmax=None) -> dict[str, float]:
-    """The {p50, p90, p99} record embedded in a quantile counter dump."""
-    return {
-        name: estimate(bounds, buckets, q, vmin=vmin, vmax=vmax)
-        for name, q in REPORTED
-    }
+    """The {p50, p90, p99} record embedded in a quantile counter dump.
+
+    Single cumulative walk resolving every reported rank in ascending
+    order — dumps run this over dozens of quantile counters per bench
+    stage, so one pass per counter, not one per quantile.  Must stay
+    value-equivalent to per-quantile `estimate()` calls
+    (tests/test_obs.py pins the equivalence)."""
+    total = sum(buckets)
+    if total <= 0:
+        return {name: 0.0 for name, _ in REPORTED}
+    out: dict[str, float] = {}
+    ranks = sorted(((q * total, name) for name, q in REPORTED))
+    r = 0  # next unresolved rank
+    cum = 0.0
+    for i, n in enumerate(buckets):
+        if n == 0:
+            continue
+        while r < len(ranks) and cum + n >= ranks[r][0]:
+            rank, name = ranks[r]
+            out[name] = _interpolate(bounds, i, cum, n, rank, vmin, vmax)
+            r += 1
+        if r == len(ranks):
+            return out
+        cum += n
+    # ranks beyond the last populated bucket (fp rounding): the maximum
+    tail = vmax if vmax is not None else (bounds[-1] if bounds else 0.0)
+    for rank, name in ranks[r:]:
+        out[name] = tail
+    return out

@@ -124,6 +124,8 @@ INSTANTS: dict[str, str] = {
     "serve.degraded": "serve dispatch lost the device; batch answered "
                       "by the host mapper",
     "serve.recovered": "serve dispatch returned to the device",
+    "health.raised": "a health check transitioned OK -> raised",
+    "health.cleared": "a health check transitioned raised -> OK",
 }
 
 COUNTERS: dict[str, str] = {

@@ -142,8 +142,26 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
                     time.sleep(settle_s)
                 # post-churn grace: the control plane goes quiet and
                 # the clients get the final map to themselves, so the
-                # summary always carries served-ok samples
-                time.sleep(max(10 * settle_s, 0.3))
+                # summary always carries served-ok samples.  If churn
+                # left the SLO story mid-episode (nothing scored yet, a
+                # burn open, or breaches still in the fast window),
+                # hold the quiet load — bounded — until the engine sees
+                # a clean fast window: the raise->clear transition is
+                # part of the recorded trajectory, not a truncated
+                # cliffhanger
+                def _episode_open() -> bool:
+                    if not obs.health.enabled():
+                        return False
+                    st = svc.slo.status()
+                    return (svc.slo.samples == 0 or st["burning"]
+                            or st["fast_burn"] > 0)
+
+                grace_end = time.perf_counter() + max(10 * settle_s, 0.3)
+                slo_end = time.perf_counter() + 30.0
+                while time.perf_counter() < grace_end or (
+                        _episode_open()
+                        and time.perf_counter() < slo_end):
+                    time.sleep(settle_s)
             else:
                 # resumed service: a short verification load, no churn
                 time.sleep(max(10 * settle_s, 0.2))
@@ -188,6 +206,11 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
         "queries_shed": delta("queries_shed"),
         "queries_expired": delta("queries_expired"),
         "provenance": svc.provenance(),
+        # the recorded-trajectory story: the burn engine's verdict plus
+        # the serve-series extract the timeline kept through the churn
+        "slo": svc.slo.status(),
+        "health": obs.health.summary(),
+        "timeline_samples": obs.timeline.next_index("serve"),
     })
     if sim is not None:
         out["sim_digest"] = sim.digest

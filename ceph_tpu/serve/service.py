@@ -58,6 +58,7 @@ from ceph_tpu.osd.incremental import Incremental, apply_incremental
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.types import PgId
 from ceph_tpu.runtime import Checkpoint, faults
+from ceph_tpu.serve.slo import SloEngine
 from ceph_tpu.utils import knobs
 from ceph_tpu.utils.dout import subsys_logger
 
@@ -300,6 +301,9 @@ class PlacementService:
 
                 m = decode_osdmap(base64.b64decode(state["map_b64"]))
                 self.resumed_from = int(state["epoch"])
+                if state.get("timeline"):
+                    # resumed services continue the same sample indices
+                    obs.timeline.restore("serve", state["timeline"])
                 _log(1, f"serve resumed at epoch {self.resumed_from}")
         if m is None:
             raise ValueError(
@@ -315,6 +319,9 @@ class PlacementService:
         self._degraded_left = 0
         self.fallback_events: list[str] = []
         self._swaps_since_ck = 0
+        self.slo = SloEngine()
+        self._slo_prev: dict = {}  # counter snapshot at last window sample
+        self._slo_t = 0.0
         self._active = self._stage(m)
         self._checkpoint()
         self._thread = threading.Thread(
@@ -502,6 +509,7 @@ class PlacementService:
             "epoch": self._active.epoch,
             "map_b64": base64.b64encode(
                 encode_osdmap(self._active.m)).decode(),
+            "timeline": obs.timeline.state("serve"),
         })
         self._swaps_since_ck = 0
         _L.inc("serve_checkpoints")
@@ -659,6 +667,64 @@ class PlacementService:
                         _L.observe("request_seconds",
                                    time.perf_counter() - r.t0)
                     off += n
+        self._observe_window()
+
+    def _observe_window(self) -> None:
+        """Pure-observer tail of a dispatch window: score an SLO sample
+        and record a "serve" timeline point from counter deltas already
+        on the host.  Windowed p99 comes from the delta of the
+        cumulative request-latency histogram between samples (so it can
+        recover after a spike, unlike the lifetime-cumulative p99).
+        Throttled to one sample per 50 ms of dispatch activity."""
+        if not (obs.health.enabled() or obs.timeline.enabled()):
+            return
+        now = time.perf_counter()
+        if now - self._slo_t < 0.05:
+            return
+        self._slo_t = now
+        d = _L.dump()
+        prev = self._slo_prev
+
+        def delta(k: str) -> int:
+            return int(d.get(k, 0)) - int(prev.get(k, 0))
+
+        req = d.get("request_seconds") or {}
+        buckets = req.get("buckets")
+        p99 = None
+        if buckets:
+            pb = prev.get("_req_buckets")
+            window = ([a - b for a, b in zip(buckets, pb)]
+                      if pb is not None and len(pb) == len(buckets)
+                      else list(buckets))
+            if sum(window) > 0:
+                p99 = obs.quantiles.summarize(
+                    req["bounds"], window)["p99"]
+        ok = delta("queries")
+        errors = delta("queries_expired")
+        shed = delta("queries_shed")
+        total = ok + errors + shed
+        self._slo_prev = {
+            k: d.get(k, 0)
+            for k in ("queries", "queries_expired", "queries_shed",
+                      "degraded_answered")
+        }
+        self._slo_prev["_req_buckets"] = list(buckets) if buckets else None
+        if total <= 0:
+            return  # nothing answered since the last sample
+        sample = {"fast_burn": self.slo._burn(self.slo.FAST)}
+        if obs.health.enabled():
+            sample = self.slo.observe(
+                p99_s=p99, queries=total, errors=errors, shed=shed)
+        obs.timeline.sample("serve", {
+            "epoch": self.epoch,
+            "queries": total,
+            "expired": errors,
+            "shed": shed,
+            "degraded": delta("degraded_answered"),
+            "p99_ms": (p99 or 0.0) * 1e3,
+            "burning": int(self.slo.burning),
+            "fast_burn": sample["fast_burn"],
+        })
 
     # -- introspection / lifecycle ----------------------------------------
 
@@ -718,6 +784,8 @@ class PlacementService:
             "swap_stall_p99_s": stall.get("p99"),
             "request_p50_s": req.get("p50"),
             "request_p99_s": req.get("p99"),
+            "health": obs.health.status(),
+            "slo": self.slo.status(),
             # the client-visible story the lifetime workload model
             # tells (sim/workload.py, booked when a chaos harness runs
             # the simulator in this process): the daemon and the

@@ -600,6 +600,8 @@ class LifetimeSim:
         # byte-conservation invariant catches a disagreeing data plane
         self.recovery_corrupt_hook = None
         self.steady_full_rebuilds = 0
+        # per-epoch summarized health status tallies (obs/health.py)
+        self._health_counts = {"ok": 0, "warn": 0, "err": 0}
         self._prev_skeys: frozenset | None = None
         self._last_balance_key = None
         self._overlay_checked: dict[int, tuple] = {}
@@ -658,6 +660,8 @@ class LifetimeSim:
                          else self.recovery.state()),
             "workload": (None if self.workload is None
                          else self.workload.state()),
+            "health_epochs": dict(self._health_counts),
+            "timeline": obs.timeline.state("sim"),
         }
 
     def _restore(self, state: dict) -> None:
@@ -696,6 +700,11 @@ class LifetimeSim:
             self.recovery.restore(state["recovery"])
         if self.workload is not None and state.get("workload"):
             self.workload.restore(state["workload"])
+        self._health_counts = dict(
+            state.get("health_epochs") or {"ok": 0, "warn": 0, "err": 0})
+        if state.get("timeline"):
+            # resumed runs continue the same monotonic sample indices
+            obs.timeline.restore("sim", state["timeline"])
         self.resumed_from = self.steps
         _log(1, f"lifetime resumed at epoch {self.steps} "
                 f"(map epoch {self.m.epoch})")
@@ -1318,6 +1327,8 @@ class LifetimeSim:
                     raise RuntimeError(f"balancer execute: {msg}")
                 changed = (len(plan.inc.new_pg_upmap_items)
                            + len(plan.inc.old_pg_upmap_items))
+                obs.timeline.sample("balancer",
+                                    {"epoch": e, "changed": changed})
                 return f"balance changed={changed}"
         except Exception as exc:
             # same contract as _account_epoch: REAL transport losses
@@ -1582,6 +1593,10 @@ class LifetimeSim:
         wall = time.perf_counter() - t0
         self._wall_this_proc += wall
         _L.observe("epoch_seconds", wall)
+        # observation AFTER the digest update: health/timeline read only
+        # the host ints accounting already fetched, so enabling them is
+        # bit-invisible to the replay digest by construction
+        health_status = self._observe_epoch(e, stats, rec, wl, structural)
         every = self.scenario.checkpoint_every
         if self.ck is not None and every and e % every == 0:
             self._checkpoint()
@@ -1592,7 +1607,52 @@ class LifetimeSim:
             "sim_epoch_s": epoch_s,
             "structural": structural,
             "compiles": compiles,
+            "health": health_status,
         }
+
+    def _observe_epoch(self, e: int, stats: dict, rec: dict | None,
+                       wl: dict | None, structural: bool) -> str:
+        """Pure-observer tail of step(): evaluate the health checks and
+        record the "sim" timeline sample from numbers that already
+        crossed the device boundary.  No device work, no digest input —
+        `CEPH_TPU_HEALTH=0` / `CEPH_TPU_TIMELINE_CAP=0` skip it with
+        zero effect on replay digests or compile counts."""
+        health = obs.health
+        totals = {k: 0 for k in ("degraded", "unmapped", "at_risk",
+                                 "moved")}
+        for st in stats.values():
+            for k in totals:
+                totals[k] += st[k]
+        backlog_gb = (rec["backlog_total"] / 1e9) if rec else 0.0
+        status = health.OK
+        if health.enabled():
+            exists = down = 0
+            for o in range(self.m.max_osd):
+                if self.m.exists(o):
+                    exists += 1
+                    if self.m.is_down(o):
+                        down += 1
+            status = health.evaluate(
+                osds_down=down, osd_count=exists,
+                degraded=totals["degraded"], unmapped=totals["unmapped"],
+                at_risk=totals["at_risk"], backlog_gb=backlog_gb,
+                device_degraded=len(self.fallback_events),
+            )
+            key = {health.OK: "ok", health.WARN: "warn",
+                   health.ERR: "err"}[status]
+            self._health_counts[key] += 1
+        obs.timeline.sample("sim", {
+            "epoch": e,
+            "degraded": totals["degraded"],
+            "unmapped": totals["unmapped"],
+            "at_risk": totals["at_risk"],
+            "moved": totals["moved"],
+            "backlog_gb": backlog_gb,
+            "throttled": (wl or {}).get("throttled", 0),
+            "structural": int(structural),
+            "health": health.rank(status),
+        })
+        return status
 
     def _integrate(self, stats: dict, rec: dict | None = None) -> float:
         sc = self.scenario
@@ -1695,6 +1755,11 @@ class LifetimeSim:
                 / (wall / 3600.0), 3
             ) if wall else 0.0,
             "recovery_model": self.scenario.recovery,
+            "health": {
+                **obs.health.summary(),
+                "epochs": dict(self._health_counts),
+                "timeline_samples": obs.timeline.next_index("sim"),
+            },
             "recovery": (None if self.recovery is None
                          else self.recovery.summary()),
             "workload": (None if self.workload is None

@@ -113,6 +113,15 @@ def test_seeded_regressions_flagged():
         # bit-exactness contract itself, compared raw
         "multichip.ok",                        # the wrapper verdict bit
         "multichip.scaling.digest_match",      # True -> False
+        # health / SLO (v9, seeded in r15->r16): seeded scenarios, so
+        # a status-rank shift, err epochs appearing, a burn that never
+        # clears, or the pure-observer bit flipping are semantic drift
+        "lifetime.health.rank",                # HEALTH_OK -> HEALTH_ERR
+        "lifetime.health.err_epochs",          # 0 -> 9
+        "lifetime.health_pure",                # True -> False
+        "serve.health.rank",                   # HEALTH_OK -> HEALTH_WARN
+        "serve.slo.burns_cleared",             # 1 -> 0: burn never cleared
+        "serve.slo.breaches",                  # 6 -> 94
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -122,6 +131,7 @@ def test_seeded_regressions_flagged():
         "serve.request_p99_s",                 # serving tail x7.5
         "lifetime.workload.served_qps",        # pareto service -32%
         "lifetime.recovery.drain_gbps",        # drain rate -45%
+        "serve.slo.burn_minutes",              # 0.02 -> 1.8 burning
         # candidate-batched optimizer (v8, seeded in r13->r14):
         # batching went inert — back to ~1 dispatch per change; same
         # calibration, so it flags as a same-machine semantic slowdown
@@ -209,6 +219,39 @@ def test_mesh_batch_fixture_pairs_v8():
     assert m["multichip.scaling.digest_match"][0] == 1.0
     assert m["multichip.scaling.eps_per_device"][2] is False  # raw
     assert "multichip.dispatch_reduction_x" in m
+
+
+def test_health_slo_fixture_pair_v9():
+    """The v9 seeded pair in isolation: the healthy observability round
+    (r15) against the health regression (r16) — the status rank shift,
+    the err epochs appearing, the SLO_BURN that never cleared, and the
+    pure-observer proof bit all flag raw (seeded scenarios: semantic
+    drift); burn_minutes flags normalized (wall-clock under burning)."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r15"], by["r16"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    for name in ("lifetime.health.rank", "lifetime.health.err_epochs",
+                 "lifetime.health_pure", "serve.health.rank",
+                 "serve.slo.burns_cleared", "serve.slo.breaches"):
+        assert name in flagged, name
+        assert not flagged[name]["normalized"]  # structural: raw
+    assert "serve.slo.burn_minutes" in flagged
+    assert flagged["serve.slo.burn_minutes"]["normalized"]
+    # the healthy record alone extracts the full v9 shape
+    m = extract_metrics(by["r15"].record)
+    assert m["lifetime.health.rank"][0] == 0.0
+    assert m["lifetime.health.timeline_samples"][0] == 48
+    assert m["lifetime.health_pure"][0] == 1.0
+    assert m["serve.slo.burns_raised"][0] == 1
+    assert m["serve.timeline_samples"][0] == 220
+    # the healthy direction (r14 regression recovering into r15) never
+    # flags a health/SLO metric
+    rep2 = diff_series([by["r14"], by["r15"]])
+    assert not any(
+        d["metric"].startswith(("lifetime.health", "serve.slo.",
+                                "serve.health"))
+        for d in rep2["regressions"])
 
 
 def test_healthy_calibrated_rounds_are_clean():

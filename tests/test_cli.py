@@ -194,6 +194,40 @@ class TestOsdmaptool:
                              capsys)
         assert "parsed '1.5'" in out
 
+    def test_health_ok_exits_zero(self, tmp_path, capsys):
+        from ceph_tpu.osd.io import save_osdmap
+        from ceph_tpu.osd.osdmap import build_hierarchical
+        from ceph_tpu.osd.types import PgPool, PoolType
+
+        pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                      pg_num=32, pgp_num=32)
+        m = build_hierarchical(4, 4, n_rack=2, pool=pool)
+        mf = str(tmp_path / "om.bin")
+        save_osdmap(m, mf)
+        rc, out, _ = run_cli(osdmaptool, [mf, "--health"], capsys)
+        assert rc == 0
+        h = json.loads(out)
+        assert h["status"] == "HEALTH_OK" and h["checks"] == {}
+        assert "OSD_DOWN" in h["registry"]  # full dump carries the registry
+
+    def test_health_down_osd_exits_one(self, tmp_path, capsys):
+        from ceph_tpu.osd.io import save_osdmap
+        from ceph_tpu.osd.osdmap import build_hierarchical
+        from ceph_tpu.osd.types import PgPool, PoolType
+
+        pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                      pg_num=32, pgp_num=32)
+        m = build_hierarchical(4, 4, n_rack=2, pool=pool)
+        m.osd_state[0] &= ~0b10  # clear UP: osd.0 is down but exists
+        mf = str(tmp_path / "om.bin")
+        save_osdmap(m, mf)
+        rc, out, _ = run_cli(osdmaptool, [mf, "--health"], capsys)
+        assert rc == 1  # scriptable: non-OK is a nonzero exit
+        h = json.loads(out)
+        assert h["status"] != "HEALTH_OK"
+        assert h["checks"]["OSD_DOWN"]["summary"] == "1/16 osds down"
+        assert h["checks"]["PG_DEGRADED"]["count"] > 0
+
     def test_upmap_writes_commands(self, tmp_path, capsys):
         mf = str(tmp_path / "om.json")
         run_cli(osdmaptool, [mf, "--createsimple", "12", "--pg-bits", "5",

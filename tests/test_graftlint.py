@@ -409,6 +409,58 @@ def test_fault_point_flags_untested_declared_point():
                    or "'stage_end'" in m for m in msgs)
 
 
+# -- health-check -----------------------------------------------------------
+
+def test_health_check_fires_on_undeclared_code(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu.obs import health\n"
+        "health.raise_check('TOTALLY_BOGUS', health.WARN, 'x')\n"
+        "health.clear('ALSO_BOGUS')\n"
+        "health.raise_check('OSD_DOWN', health.WARN, 'declared: fine')\n"
+    ), "health-check")
+    assert [x.line for x in v] == [2, 3]
+    assert "TOTALLY_BOGUS" in v[0].message
+    assert "HEALTH_CHECKS" in v[0].message
+
+
+def test_health_check_clean_on_declared_codes(tmp_path):
+    v = lint(tmp_path, (
+        "from ceph_tpu.obs import health\n"
+        "health.raise_check('PG_UNMAPPED', health.ERR, 'x')\n"
+        "health.clear('SLO_BURN')\n"
+        "code = pick()\n"
+        "health.clear(code)\n"  # dynamic first arg: not a literal site
+    ), "health-check")
+    assert v == []
+
+
+def test_health_check_registry_module_exempt(tmp_path):
+    """obs/health.py hosts the machinery and docstring examples — an
+    undeclared literal there must not fire direction (a)."""
+    d = tmp_path / "obs"
+    d.mkdir()
+    f = d / "health.py"
+    f.write_text("health.raise_check('DOC_EXAMPLE', 'HEALTH_WARN', 'x')\n")
+    ctx = Context(paths=[], include_tests=False)
+    assert PASSES["health-check"].check_module(Module(f, REPO), ctx) == []
+
+
+def test_health_check_flags_untested_declared_code():
+    """Direction (b): a declared code no test references is a violation
+    pointing at its registry line — and every *real* code is covered."""
+    # built dynamically: a bare literal here would itself count as the
+    # test reference the pass is looking for (this file lives in tests/)
+    code = "ZZ_" + "UNTESTED"
+    ctx = Context(paths=[])  # parses tests/, no scanned modules
+    ctx.health_checks = dict(ctx.health_checks, **{code: "never seen"})
+    ctx.health_lines[code] = 1
+    PASSES["health-check"].run(ctx)
+    assert len(ctx.violations) == 1
+    v = ctx.violations[0]
+    assert code in v.message and "no test" in v.message
+    assert v.path == "ceph_tpu/obs/health.py"
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_suppression_silences_one_pass(tmp_path):

@@ -1,0 +1,300 @@
+"""Health model + timeline flight recorder + serve SLO burn engine.
+
+Three contracts under test (ceph_tpu/obs/health.py, obs/timeline.py,
+serve/slo.py):
+
+- the check registry is a declared-codes-only surface (undeclared codes
+  raise at the call site, not at cluster-unhealthy time), muting drops a
+  check from the summarized status without hiding it from dumps, and
+  `evaluate()` maps the standard host reductions onto the standard
+  codes;
+- the timeline is a bounded 2-tier recorder whose indices stay
+  monotonic across checkpoint/resume and whose tier-1 ring holds 8:1
+  averaged evictions;
+- the SLO engine is a multiwindow burn detector that drives the
+  SLO_BURN check through a full raise->clear transition;
+- and the whole stack is a *pure observer*: disabling it
+  (CEPH_TPU_HEALTH=0, CEPH_TPU_TIMELINE_CAP=0) is bit-invisible to
+  lifetime digests and steady-state compile counts.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu.obs import health, timeline
+from ceph_tpu.serve.slo import Objectives, SloEngine
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Health checks and timeline series are process globals."""
+    health.reset()
+    timeline.reset()
+    yield
+    health.reset()
+    timeline.reset()
+
+
+# ------------------------------------------------------------ health model
+
+
+def test_raise_clear_transition_booleans():
+    assert health.raise_check("OSD_DOWN", health.WARN, "2/8 osds down",
+                              count=2)
+    # refresh, not a transition
+    assert not health.raise_check("OSD_DOWN", health.WARN, "3/8 osds down",
+                                  count=3)
+    assert health.checks()["OSD_DOWN"]["count"] == 3  # refresh updated it
+    assert health.clear("OSD_DOWN")
+    assert not health.clear("OSD_DOWN")  # already clear
+    assert health.checks() == {}
+
+
+def test_undeclared_code_and_bad_severity_throw_at_call_site():
+    with pytest.raises(KeyError, match="undeclared"):
+        health.raise_check("NOT_A_CHECK", health.WARN, "x")
+    with pytest.raises(KeyError, match="undeclared"):
+        health.clear("NOT_A_CHECK")
+    with pytest.raises(ValueError, match="severity"):
+        health.raise_check("OSD_DOWN", "HEALTH_OK", "x")
+    with pytest.raises(ValueError, match="severity"):
+        health.raise_check("OSD_DOWN", "fatal", "x")
+
+
+def test_status_is_worst_unmuted_severity():
+    assert health.status() == health.OK
+    assert health.rank(health.status()) == 0
+    health.raise_check("PG_DEGRADED", health.WARN, "3 pgs degraded")
+    assert health.status() == health.WARN
+    health.raise_check("PG_UNMAPPED", health.ERR, "1 pgs unmapped")
+    assert health.status() == health.ERR
+    assert health.rank(health.ERR) == 2
+    health.clear("PG_UNMAPPED")
+    assert health.status() == health.WARN
+
+
+def test_mute_drops_from_status_but_not_from_dump(monkeypatch):
+    health.raise_check("PG_AT_RISK", health.ERR, "2 pgs past EC tolerance")
+    assert health.status() == health.ERR
+    monkeypatch.setenv("CEPH_TPU_HEALTH_MUTE", "PG_AT_RISK, SLO_BURN")
+    assert health.muted() == {"PG_AT_RISK", "SLO_BURN"}
+    assert health.status() == health.OK  # muted out of the summary...
+    s = health.summary()
+    assert s["status"] == health.OK
+    assert s["checks"]["PG_AT_RISK"]["muted"] is True  # ...but still shown
+    d = health.dump()
+    assert d["muted"] == ["PG_AT_RISK", "SLO_BURN"]
+    assert "PG_AT_RISK" in d["registry"]
+    monkeypatch.delenv("CEPH_TPU_HEALTH_MUTE")
+    assert health.status() == health.ERR  # unmute restores
+
+
+def test_evaluate_maps_standard_reductions_onto_standard_codes():
+    st = health.evaluate(
+        osds_down=2, osd_count=8, degraded=3, unmapped=1, at_risk=1,
+        backlog_gb=1.5, device_degraded=1, detail=("osd.3", "osd.5"),
+    )
+    assert st == health.ERR
+    snap = health.summary()["checks"]
+    assert set(snap) == {"OSD_DOWN", "PG_DEGRADED", "PG_UNMAPPED",
+                         "PG_AT_RISK", "RECOVERY_BACKLOG",
+                         "DEVICE_DEGRADED"}
+    assert snap["OSD_DOWN"]["summary"] == "2/8 osds down"
+    assert snap["RECOVERY_BACKLOG"]["summary"] == "1.500 GB awaiting recovery"
+    assert health.dump()["checks"]["OSD_DOWN"]["detail"] == ["osd.3", "osd.5"]
+    # recovery drains, one pg stays degraded: ERR collapses to WARN
+    st = health.evaluate(osds_down=0, osd_count=8, degraded=1)
+    assert st == health.WARN
+    assert set(health.summary()["checks"]) == {"PG_DEGRADED"}
+    # all clear
+    assert health.evaluate() == health.OK
+    assert health.checks() == {}
+
+
+def test_disabled_health_is_inert(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_HEALTH", "0")
+    assert not health.enabled()
+    assert health.evaluate(osds_down=5, osd_count=5, unmapped=9) == health.OK
+    assert health.checks() == {}
+
+
+# ------------------------------------------------------- timeline recorder
+
+
+def test_timeline_ring_eviction_folds_8_to_1(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_TIMELINE_CAP", "4")
+    assert timeline.cap() == 4
+    for k in range(12):  # 8 evictions -> exactly one tier-1 sample
+        assert timeline.sample("s", {"v": float(k)}) == k
+    d = timeline.dump("s")
+    assert d["count"] == 12
+    assert d["tier0"]["index"] == [8, 9, 10, 11]
+    assert d["tier0"]["fields"]["v"] == [8.0, 9.0, 10.0, 11.0]
+    assert d["tier1"]["factor"] == timeline.TIER1_FACTOR == 8
+    assert d["tier1"]["index"] == [0]  # stamped with the window's first
+    assert d["tier1"]["fields"]["v"] == [pytest.approx(sum(range(8)) / 8)]
+    assert timeline.next_index("s") == 12
+    assert timeline.last("s") == (11, {"v": 11.0})
+
+
+def test_timeline_absent_field_reads_zero(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_TIMELINE_CAP", "8")
+    timeline.sample("s", {"a": 1.0})
+    timeline.sample("s", {"b": 2.0})
+    assert timeline.last("s") == (1, {"a": 0.0, "b": 2.0})
+
+
+def test_timeline_state_restore_continues_indices(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_TIMELINE_CAP", "4")
+    for k in range(10):
+        timeline.sample("s", {"v": float(k)})
+    st = timeline.state("s")
+    before = timeline.dump("s")
+    timeline.reset()
+    assert timeline.next_index("s") == 0
+    timeline.restore("s", st)
+    assert timeline.dump("s") == before  # both tiers survive the trip
+    # the monotonic index continues exactly where the checkpoint stopped
+    assert timeline.next_index("s") == 10
+    assert timeline.sample("s", {"v": 10.0}) == 10
+    # the fold accumulator survived too: the 6 pre-checkpoint evictions
+    # plus the post-resume ones close tier-1 windows on schedule
+    for k in range(11, 20):
+        timeline.sample("s", {"v": float(k)})
+    d = timeline.dump("s")
+    assert d["tier1"]["index"] == [0, 8]
+    assert d["tier1"]["fields"]["v"] == [
+        pytest.approx(sum(range(0, 8)) / 8),
+        pytest.approx(sum(range(8, 16)) / 8),
+    ]
+
+
+def test_timeline_disabled_is_inert(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_TIMELINE_CAP", "0")
+    assert not timeline.enabled()
+    assert timeline.sample("s", {"v": 1.0}) == -1
+    assert timeline.next_index("s") == 0
+    timeline.restore("s", {"count": 5})  # no-op while disabled
+    assert timeline.dump() == {}
+    assert timeline.prometheus_gauges() == ""
+
+
+# --------------------------------------------------- serve SLO burn engine
+
+
+def test_slo_engine_raises_then_clears_slo_burn():
+    obj = Objectives(p99_s=0.1, error_ratio=0.01, shed_ratio=0.05)
+    assert obj.as_dict() == {"p99_ms": 100.0, "error_pct": 1.0,
+                             "shed_pct": 5.0}
+    eng = SloEngine(obj)
+    t = 0.0
+    r = eng.observe(p99_s=0.5, queries=100, errors=0, shed=0, wall_t=t)
+    assert r["breach"] and r["reasons"] == ["p99"] and not r["burning"]
+    t += 1.0  # second breaching sample: fast=1.0, slow=1.0 -> raise
+    r = eng.observe(p99_s=0.5, queries=100, errors=0, shed=0, wall_t=t)
+    assert r["burning"] and eng.burns_raised == 1
+    assert "SLO_BURN" in health.checks()
+    assert health.status() == health.WARN
+    # clears only after a full fast window of clean samples
+    for k in range(SloEngine.FAST):
+        t += 1.0
+        r = eng.observe(p99_s=0.01, queries=100, errors=0, shed=0, wall_t=t)
+        assert r["burning"] == (k < SloEngine.FAST - 1)
+    assert eng.burns_cleared == 1
+    assert "SLO_BURN" not in health.checks()
+    st = eng.status()
+    assert st["samples"] == 10 and st["breaches"] == 2
+    # burning t=1..9; status() rounds to 4 decimals
+    assert st["burn_minutes"] == pytest.approx(8 / 60.0, abs=1e-3)
+
+
+def test_slo_engine_scores_error_and_shed_ratios():
+    eng = SloEngine(Objectives(p99_s=1.0, error_ratio=0.01, shed_ratio=0.05))
+    r = eng.observe(p99_s=0.001, queries=100, errors=2, shed=6, wall_t=0.0)
+    assert r["reasons"] == ["errors", "shed"]
+    r = eng.observe(p99_s=None, queries=100, errors=1, shed=5, wall_t=1.0)
+    assert not r["breach"]  # at-objective is not a breach; p99 unknown
+
+
+def test_slo_objectives_from_env(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_SLO_P99_MS", "100")
+    monkeypatch.setenv("CEPH_TPU_SLO_ERROR_PCT", "2")
+    monkeypatch.setenv("CEPH_TPU_SLO_SHED_PCT", "10")
+    obj = Objectives.from_env()
+    assert obj == Objectives(p99_s=0.1, error_ratio=0.02, shed_ratio=0.1)
+
+
+# ------------------------------------------------------ pure-observer pin
+
+
+def test_observers_are_bit_invisible_to_lifetime_digest(monkeypatch):
+    """THE purity contract: the same tiny jax lifetime run with health +
+    timeline enabled vs disabled lands on the identical replay digest
+    and identical steady-state compile count — observation must never
+    leak into device work."""
+    from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+    spec = ("epochs=12,seed=5,hosts=6,osds_per_host=2,racks=2,pgs=32,"
+            "ec=2+2,ec_pgs=16,chunk=256,balance_every=6,spotcheck_every=4,"
+            "checkpoint_every=0")
+    on = LifetimeSim(Scenario.parse(spec), backend="jax").run()
+    assert sum(on["health"]["epochs"].values()) == 12  # every epoch scored
+    assert on["health"]["timeline_samples"] == 12
+
+    monkeypatch.setenv("CEPH_TPU_HEALTH", "0")
+    monkeypatch.setenv("CEPH_TPU_TIMELINE_CAP", "0")
+    health.reset()
+    timeline.reset()
+    off = LifetimeSim(Scenario.parse(spec), backend="jax").run()
+    assert sum(off["health"]["epochs"].values()) == 0  # observers off
+    assert off["health"]["timeline_samples"] == 0
+
+    assert off["digest"] == on["digest"]
+    assert (off["trace_once"]["steady_compiles"]
+            == on["trace_once"]["steady_compiles"] == 0)
+
+
+# ------------------------------------- osdmaptool USAGE vs parser contract
+
+
+def test_osdmaptool_usage_matches_parser():
+    """Every flag the USAGE banner advertises is either handled by the
+    arg loop or on the explicit not-implemented list — the banner is the
+    tool's contract, and the reference's silent-skip argparse makes a
+    drifted flag a no-op instead of an error."""
+    src = (REPO / "ceph_tpu" / "cli" / "osdmaptool.py").read_text()
+    tree = ast.parse(src)
+
+    usage = next(
+        n.value.value for n in ast.walk(tree)
+        if isinstance(n, ast.Assign)
+        and any(isinstance(t, ast.Name) and t.id == "USAGE"
+                for t in n.targets)
+    )
+    advertised = {
+        line.strip().split()[0]
+        for line in usage.splitlines()
+        if line.strip().startswith("--")
+    }
+
+    parsed = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("flag", "witharg", "withint")):
+            parsed |= {a.value for a in node.args
+                       if isinstance(a, ast.Constant)
+                       and isinstance(a.value, str)}
+    parsed.add("--tree")  # handled via peek() for the --tree=json form
+
+    # reference features the graft intentionally leaves out
+    UNIMPLEMENTED = {"--clear-temp", "--clean-temps", "--test-random",
+                     "--upmap-active", "--test-crush"}
+    assert advertised - parsed == UNIMPLEMENTED
+    assert not (UNIMPLEMENTED & parsed), "implemented flag still listed"

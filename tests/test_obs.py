@@ -218,9 +218,10 @@ def test_balancer_counters_advance():
 
 # -- Prometheus text exposition --------------------------------------------
 
+_LABEL = r'[a-zA-Z_]+="(?:[^"\\]|\\.)*"'
 _METRIC_LINE = re.compile(
-    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[a-zA-Z_]+=\"[^\"]+\"\})? (-?\d+(\.\d+)?"
-    r"(e[+-]?\d+)?|NaN)$"
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{" + _LABEL + r"(," + _LABEL + r")*\})? "
+    r"(-?\d+(\.\d+)?(e[+-]?\d+)?|NaN)$"
 )
 
 
@@ -295,6 +296,88 @@ def test_prometheus_text_valid():
     assert "ceph_tpu_t_prom_hits 2" in text
     assert 'ceph_tpu_t_prom_sz_bucket{le="+Inf"} 1' in text
     assert "ceph_tpu_t_prom_lat_count 1" in text
+
+
+def test_prometheus_health_timeline_gauges_golden(monkeypatch):
+    """Exact exposition of the health-check and timeline gauges, with
+    the label-escaping path exercised: a check summary embedding `\\`,
+    `"` and a newline must stay one valid exposition line."""
+    from ceph_tpu.obs import health, timeline
+
+    monkeypatch.setenv("CEPH_TPU_HEALTH_MUTE", "PG_DEGRADED")
+    health.reset()
+    timeline.reset()
+    try:
+        health.raise_check("OSD_DOWN", health.WARN, "1/8 osds down", count=1)
+        health.raise_check("PG_DEGRADED", health.WARN,
+                           '3 pgs "degraded"\nback\\slash', count=3)
+        assert health.prometheus_gauges() == (
+            "# HELP ceph_tpu_health_status cluster health "
+            "(0=OK 1=WARN 2=ERR)\n"
+            "# TYPE ceph_tpu_health_status gauge\n"
+            "ceph_tpu_health_status 1\n"
+            "# HELP ceph_tpu_health_check per-check count (labels: code, "
+            "severity, summary, muted)\n"
+            "# TYPE ceph_tpu_health_check gauge\n"
+            'ceph_tpu_health_check{code="OSD_DOWN",severity="HEALTH_WARN",'
+            'summary="1/8 osds down",muted="0"} 1\n'
+            'ceph_tpu_health_check{code="PG_DEGRADED",'
+            'severity="HEALTH_WARN",'
+            'summary="3 pgs \\"degraded\\"\\nback\\\\slash",muted="1"} 3\n'
+        )
+
+        timeline.sample("serve", {"p99_s": 0.25, "qps": 1000.0})
+        timeline.sample("serve", {"p99_s": 0.5, "qps": 2000.0})
+        timeline.sample("sim", {"health": 1.0})
+        assert timeline.prometheus_gauges() == (
+            "# HELP ceph_tpu_timeline_samples samples recorded per series\n"
+            "# TYPE ceph_tpu_timeline_samples gauge\n"
+            'ceph_tpu_timeline_samples{series="serve"} 2\n'
+            'ceph_tpu_timeline_samples{series="sim"} 1\n'
+            "# HELP ceph_tpu_timeline_last newest sample value per "
+            "series/field\n"
+            "# TYPE ceph_tpu_timeline_last gauge\n"
+            'ceph_tpu_timeline_last{series="serve",field="p99_s"} 0.5\n'
+            'ceph_tpu_timeline_last{series="serve",field="qps"} 2000.0\n'
+            'ceph_tpu_timeline_last{series="sim",field="health"} 1.0\n'
+        )
+
+        # the package-level exposition now carries these multi-label
+        # lines — every one must still parse as a valid metric line
+        for line in obs.prometheus_text().rstrip("\n").split("\n"):
+            if line.startswith("#"):
+                assert re.match(
+                    r"^# (HELP|TYPE) [a-zA-Z_][a-zA-Z0-9_]* ", line)
+            else:
+                assert _METRIC_LINE.match(line), f"bad line: {line!r}"
+    finally:
+        health.reset()
+        timeline.reset()
+
+
+# -- quantile summarize == per-quantile estimate ---------------------------
+
+def test_quantile_summarize_matches_estimate():
+    """The single-pass `summarize()` (one cumulative walk per counter
+    dump) must stay value-equivalent to three independent `estimate()`
+    walks, across randomized dense/sparse histograms with and without
+    tracked min/max."""
+    from ceph_tpu.obs import quantiles
+
+    bounds = list(quantiles.DEFAULT_BOUNDS)
+    rng = np.random.default_rng(42)
+    for trial in range(200):
+        buckets = rng.integers(0, 6, size=len(bounds) + 1)
+        buckets[rng.integers(0, len(buckets), size=20)] = 0  # sparse holes
+        vmin = float(rng.uniform(1e-7, 1e-5)) if trial % 3 else None
+        vmax = float(rng.uniform(10.0, 1000.0)) if trial % 2 else None
+        s = quantiles.summarize(bounds, buckets, vmin=vmin, vmax=vmax)
+        for name, q in quantiles.REPORTED:
+            assert s[name] == quantiles.estimate(
+                bounds, buckets, q, vmin=vmin, vmax=vmax
+            ), (trial, name)
+    assert quantiles.summarize(bounds, [0] * (len(bounds) + 1)) == {
+        "p50": 0.0, "p90": 0.0, "p99": 0.0}
 
 
 # -- dout line shape + set_output ------------------------------------------

@@ -84,7 +84,18 @@ from pathlib import Path
 #     change; lower is better, calibration-normalized alongside the
 #     stage's wall times) and `seq_dispatches_per_change` for the
 #     same-run sequential baseline.
-SCHEMA_VERSION = 8
+# v9: cluster health model + timeline flight recorder + serve SLO
+#     burn-rate engine (obs/health.py, obs/timeline.py, serve/slo.py).
+#     The lifetime stage grows `health` (summarized status rank, per-
+#     epoch ok/warn/err counts, sim-timeline sample count) and the
+#     `health_pure` proof bit (observers on == observers off, digest
+#     and compile-count identical); the serve stage grows `slo`
+#     (burns_raised/burns_cleared/breaches — the chaos phase must
+#     record a full raise->clear cycle — plus burn_minutes), a
+#     summarized `health` status and the serve-timeline sample count.
+#     Everything raw except burn_minutes (wall-clock) — the scenarios
+#     are seeded, so a check that stops firing is semantic drift.
+SCHEMA_VERSION = 9
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -395,6 +406,22 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         rcv.get("fallback_epochs"), False, False)
     put("lifetime.recovery.drain_gbps", rcv.get("drain_gbps"),
         True, True)
+    # cluster health model (v9): the chaos scenario is seeded, so the
+    # summarized status and the warn/err epoch split are bit-determined
+    # — raw compares; the pure-observer proof bit pins that enabling
+    # the observers changed no digest byte and compiled nothing.
+    rank = {"HEALTH_OK": 0.0, "HEALTH_WARN": 1.0, "HEALTH_ERR": 2.0}
+    hl = lf.get("health") or {}
+    if hl.get("status") in rank:
+        out["lifetime.health.rank"] = (rank[hl["status"]], False, False)
+    hep = hl.get("epochs") or {}
+    put("lifetime.health.warn_epochs", hep.get("warn"), False, False)
+    put("lifetime.health.err_epochs", hep.get("err"), False, False)
+    put("lifetime.health.timeline_samples",
+        hl.get("timeline_samples"), True, False)
+    if isinstance(lf.get("health_pure"), bool):
+        out["lifetime.health_pure"] = (
+            float(lf["health_pure"]), True, False)
     wl = lf.get("workload") or {}
     put("lifetime.workload.served_qps", wl.get("served_qps"),
         True, True)
@@ -434,6 +461,22 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
     cz = sv.get("chaos") or {}
     put("serve.chaos.dropped", cz.get("dropped"), False, False)
     put("serve.chaos.p99_s", cz.get("p99_s"), False, True)
+    # serve SLO burn-rate engine (v9): load and fault cadence are
+    # seeded, so burn transitions are semantic facts — the chaos phase
+    # must keep recording its raise->clear cycle (burns_cleared
+    # dropping to 0 is the regression the fixture pair seeds); only
+    # burn_minutes is wall-clock.
+    slo = sv.get("slo") or {}
+    put("serve.slo.burns_raised", slo.get("burns_raised"), False, False)
+    put("serve.slo.burns_cleared", slo.get("burns_cleared"),
+        True, False)
+    put("serve.slo.breaches", slo.get("breaches"), False, False)
+    put("serve.slo.samples", slo.get("samples"), True, False)
+    put("serve.slo.burn_minutes", slo.get("burn_minutes"), False, True)
+    if sv.get("health") in rank:
+        out["serve.health.rank"] = (rank[sv["health"]], False, False)
+    put("serve.timeline_samples", sv.get("timeline_samples"),
+        True, False)
     # multichip trajectory (normalized MULTICHIP_r*.json wrappers)
     mc = rec.get("multichip") or {}
     put("multichip.n_devices", mc.get("n_devices"), True, False)

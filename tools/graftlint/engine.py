@@ -37,6 +37,7 @@ TEST_DIR = "tests"
 SPAN_REGISTRY = "ceph_tpu/obs/spans.py"
 KNOB_REGISTRY = "ceph_tpu/utils/knobs.py"
 FAULT_REGISTRY = "ceph_tpu/runtime/faults.py"
+HEALTH_REGISTRY = "ceph_tpu/obs/health.py"
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,-]+)")
 
@@ -228,6 +229,8 @@ class Context:
             self.root / KNOB_REGISTRY, "KNOBS", {})
         self.fault_points, self.fault_lines = _load_registry(
             self.root / FAULT_REGISTRY, "FAULT_POINTS", {})
+        self.health_checks, self.health_lines = _load_registry(
+            self.root / HEALTH_REGISTRY, "HEALTH_CHECKS", {})
 
     @property
     def test_modules(self) -> list[Module]:

@@ -10,6 +10,7 @@ from tools.graftlint.passes import (  # noqa: F401
     counter_decl,
     env_knob,
     fault_point,
+    health_check,
     host_sync,
     no_print,
     span_name,

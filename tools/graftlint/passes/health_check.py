@@ -1,0 +1,87 @@
+"""health-check: health codes are declared, and every one is tested.
+
+`obs/health.py` declares the compiled-in health check codes
+(`HEALTH_CHECKS`).  Two contract directions, same shape as fault-point:
+
+- every production `health.raise_check("<CODE>", ...)` /
+  `health.clear("<CODE>")` literal must use a declared code — an
+  undeclared code would raise KeyError at the exact moment the cluster
+  is unhealthy, which is when the observer must not throw;
+- every declared code must be referenced by at least one test
+  (raise/clear literals or a bare "<CODE>" string constant in tests/) —
+  an untested check is an alert nobody has ever seen fire.
+
+The registry-hosting module itself is exempt from direction (a): it
+hosts the standard-evaluation machinery and the docstring examples.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, register,
+)
+
+HEALTH_MODULE = "ceph_tpu/obs/health.py"
+
+
+def _code_sites(module: Module):
+    """Yield (code, node) for health.raise_check/clear string literals."""
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        c = module.canonical(node.func)
+        if c is None:
+            continue
+        if c.endswith("health.raise_check") or c.endswith("health.clear") \
+                or ("." not in c and c == "raise_check"
+                    and module.from_alias.get(c, "").endswith(
+                        "health.raise_check")):
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                yield a0.value, node
+
+
+@register
+class HealthCheckPass(Pass):
+    name = "health-check"
+    doc = "health codes declared in HEALTH_CHECKS; each covered by a test"
+
+    def run(self, ctx: Context) -> None:
+        if not ctx.health_checks:
+            return
+        # (a) production sites use declared codes
+        for m in ctx.modules:
+            if m.tree is None:
+                continue
+            if m.rel.endswith("obs/health.py"):
+                continue  # hosts the machinery (and doc examples)
+            for code, node in _code_sites(m):
+                if code not in ctx.health_checks:
+                    ctx.violations.append(Violation(
+                        m.rel, node.lineno, self.name,
+                        f"health check code {code!r} is not declared in "
+                        "obs/health.py HEALTH_CHECKS",
+                    ))
+
+        # (b) every declared code is exercised by at least one test
+        if not ctx.test_modules:
+            return
+        referenced: set[str] = set()
+        for tm in ctx.test_modules:
+            if tm.tree is None:
+                continue
+            for code, _ in _code_sites(tm):
+                referenced.add(code)
+            for node in ast.walk(tm.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str) and node.value in ctx.health_checks:
+                    referenced.add(node.value)
+        for code in sorted(ctx.health_checks):
+            if code not in referenced:
+                ctx.violations.append(Violation(
+                    HEALTH_MODULE, ctx.health_lines.get(code, 1), self.name,
+                    f"declared health check {code!r} is referenced by no "
+                    "test — an alert nobody has ever seen fire",
+                ))
