@@ -1035,7 +1035,8 @@ def bench_serve(h) -> dict:
         "epochs", "qps", "p50_s", "p99_s", "dropped", "swaps_ok",
         "swaps_rejected", "swap_stall_p99_s", "queries_shed",
         "queries_expired", "sim_violations", "degraded_reads_served",
-        "at_risk_hits", "recovery_backlog_gb")}
+        "at_risk_hits", "recovery_backlog_gb", "traffic",
+        "client_read_mix")}
     # health / SLO / timeline (schema v9): the burn-rate engine's
     # transition counts, the summarized end-of-stage status, and the
     # serve-timeline sample count
@@ -1056,12 +1057,32 @@ DEFAULT_LIFETIME_SCENARIO = (
     # the recovery data plane + client workload (PR 14): queue-model
     # recovery with RapidRAID-style pipelined EC repair, and seeded
     # client traffic so the headline is a pareto record —
-    # cluster-years/hour AT a stated served QPS.  Bandwidth/slots are
-    # scarce on purpose (one backfill stream per OSD, 25 MB/s) so an
-    # epoch's movement genuinely carries backlog across epochs — the
-    # behavior the flat model's silent floor discarded
+    # cluster-years/hour AT a stated served QPS.  Bandwidth is sized
+    # so a single wound's repair drains within an epoch or two —
+    # backlog still carries across epochs during cascades, but a lone
+    # death heals before the next one lands
     "recovery=queue,pipeline_repair=1,workload=1,wl_sample=64,"
-    "max_backfills=1,recovery_mbps=25,osd_mbps=50"
+    "max_backfills=4,recovery_mbps=200,osd_mbps=400,"
+    # the correlated-failure chaos model (PR 17): repeat-offender
+    # flappers, cascading domain outages via decaying sibling hazards,
+    # and per-PG dead-chunk durability accounting.  This scenario must
+    # stay SURVIVABLE (pg_lost == 0, gated in --selftest): losses are
+    # proven separately by the overwhelmed mini-run
+    "correlated=1,flappers=2"
+)
+
+# the overwhelming counterpart: a cluster too small and a recovery
+# pipe too starved for its death rate, so dead chunks stack past EC
+# tolerance before the backlog drains — pg_lost > 0 and a DATA_LOSS
+# check that latches at HEALTH_ERR are the acceptance proof that the
+# durability accounting can actually fire (not just stay zero)
+OVERWHELMED_SCENARIO = (
+    "epochs=60,hosts=3,osds_per_host=2,racks=1,pgs=16,ec=2+1,ec_pgs=8,"
+    "chunk=64,seed=7,p_death=0.25,p_flap=0.05,p_host_outage=0.10,"
+    "p_reweight=0,p_pg_temp=0,p_pool_create=0,p_split=0,p_expand=0,"
+    "p_remove=0.02,balance_every=0,spotcheck_every=0,"
+    "checkpoint_every=0,recovery=queue,max_backfills=1,"
+    "recovery_mbps=2,osd_mbps=4,correlated=1,flappers=1"
 )
 
 
@@ -1159,6 +1180,39 @@ def bench_lifetime(h) -> dict:
                    and purity[0]["steady_compiles"]
                    == purity[1]["steady_compiles"])
 
+    # backend cross-check (schema v10): the same sliced scenario on the
+    # host-only ref backend must land on the purity slice's digest —
+    # hazard decay, flapper draws, false-flap revives, and the wound
+    # ledger are exact host ints on every backend.  A slice, not the
+    # full run: ref pays ~0.8 s/epoch where jax pays ~0.05
+    with obs.span("bench.lifetime", phase="ref-slice",
+                  epochs=sc_p.epochs):
+        out_r = LifetimeSim(sc_p, backend="ref").run()
+    ref_digest_match = out_r["digest"] == purity[0]["digest"]
+
+    # the overwhelmed mini-run (schema v10): the durability ledger must
+    # be able to FIRE, not just stay zero — a starved recovery pipe
+    # under a brutal death rate stacks dead chunks past EC tolerance,
+    # loses PGs, and latches DATA_LOSS at HEALTH_ERR.  Isolated health
+    # registry: reset before (drop the main run's raised checks) and
+    # after (never leak HEALTH_ERR into later stages)
+    obs.health.reset()
+    try:
+        with obs.span("bench.lifetime", phase="overwhelmed"):
+            out_o = LifetimeSim(Scenario.parse(OVERWHELMED_SCENARIO),
+                                backend="ref").run()
+        loss_check = obs.health.checks().get("DATA_LOSS") or {}
+        overwhelmed = {
+            "pg_lost": out_o["durability"]["pg_lost"],
+            "exposed_pg_epochs":
+                out_o["durability"]["exposed_pg_epochs"],
+            "invariant_violations": out_o["invariant_violations"],
+            "data_loss_latched":
+                loss_check.get("severity") == "HEALTH_ERR",
+        }
+    finally:
+        obs.health.reset()
+
     tr = out_a["trace_once"]
     # the ClusterState O(delta) proofs: whole-run apply classification
     # and the balancer's membership builds served from the shared rows
@@ -1209,6 +1263,15 @@ def bench_lifetime(h) -> dict:
         "health": out_a.get("health"),
         "health_pure": health_pure,
         "health_purity": purity,
+        # correlated-failure chaos + durability ledger (schema v10):
+        # cascades, repeat offenders, false-flap revives, and the
+        # dead-chunk exposure record — the main run must stay
+        # SURVIVABLE (pg_lost == 0) while the overwhelmed mini-run
+        # proves the loss path fires
+        "chaos": out_a.get("chaos"),
+        "durability": out_a.get("durability"),
+        "overwhelmed": overwhelmed,
+        "ref_digest_match": ref_digest_match,
         # robustness proofs
         "device_loss_fallbacks":
             out_a["provenance"]["device_loss_fallbacks"],
@@ -2008,6 +2071,12 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
             "benchdiff did not flag the health/SLO regression seeded "
             "in the fixture series (schema v9 health/slo metrics not "
             "folded)")
+    elif not any(d["metric"].startswith("lifetime.durability.")
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the durability regression seeded "
+            "in the fixture series (schema v10 pg_lost 0->N "
+            "zero-baseline case not folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -2174,6 +2243,54 @@ def selftest() -> int:
             problems.append(
                 "lifetime workload served no degraded reads across a "
                 "chaos scenario (client-visible story missing)")
+        # correlated-failure chaos acceptance gates (schema v10): the
+        # scenario must actually cascade, flap its designated repeat
+        # offenders, and revive false-positive down-marks; the
+        # durability ledger must record real exposure yet lose NOTHING
+        # (the default scenario is sized survivable); the overwhelmed
+        # mini-run must lose PGs and latch DATA_LOSS; and the ref
+        # backend must land on the jax slice digest bit-for-bit
+        cha = lf.get("chaos") or {}
+        if not cha.get("cascades", 0) >= 1:
+            problems.append(
+                "lifetime correlated scenario produced no cascade "
+                "(sibling-hazard model inert)")
+        if not cha.get("repeat_flaps", 0) >= 2:
+            problems.append(
+                f"lifetime designated flappers flapped "
+                f"{cha.get('repeat_flaps')} time(s) (wanted >=2 — "
+                "repeat-offender model inert)")
+        if not cha.get("false_flap_revives", 0) >= 1:
+            problems.append(
+                "lifetime recorded no false-flap revive (network-flap "
+                "vs real-death distinction inert)")
+        dur = lf.get("durability") or {}
+        if dur.get("pg_lost", -1) != 0:
+            problems.append(
+                f"lifetime durability lost {dur.get('pg_lost')} PG(s) "
+                "on the SURVIVABLE default scenario (wanted 0 — either "
+                "the heal path broke or the scenario tuning drowned)")
+        if not dur.get("exposed_pg_epochs", 0) > 0:
+            problems.append(
+                "lifetime durability recorded no exposed PG-epochs "
+                "across a chaos scenario (wound ledger inert)")
+        ovw = lf.get("overwhelmed") or {}
+        if not ovw.get("pg_lost", 0) > 0:
+            problems.append(
+                "overwhelmed mini-run lost no PGs (loss path can "
+                "never fire)")
+        if not ovw.get("data_loss_latched"):
+            problems.append(
+                "overwhelmed mini-run did not latch DATA_LOSS at "
+                "HEALTH_ERR")
+        if ovw.get("invariant_violations", -1) != 0:
+            problems.append(
+                f"overwhelmed mini-run broke invariants: "
+                f"{ovw.get('invariant_violations')}")
+        if not lf.get("ref_digest_match"):
+            problems.append(
+                "lifetime ref-backend slice digest != jax slice digest "
+                "(correlated model not backend-exact)")
         # serve acceptance gates: sustained QPS with a recorded tail
         # across live epoch swaps, zero dropped queries, swaps that
         # never stall readers past the bound, 0 steady compiles,
@@ -2227,6 +2344,15 @@ def selftest() -> int:
                 f"serve chaos dropped {cz.get('dropped')} queries")
         if not cz.get("swaps_ok", 0) > 0:
             problems.append("serve chaos applied no epoch swaps")
+        if cz.get("traffic") != "workload":
+            problems.append(
+                f"serve chaos traffic was {cz.get('traffic')!r} "
+                "(wanted 'workload' — clients must draw from the "
+                "Zipf/diurnal generator, not uniform threads)")
+        if not cz.get("degraded_reads_served", 0) > 0:
+            problems.append(
+                "serve chaos served no degraded reads under "
+                "workload-driven traffic")
         # SLO burn-rate acceptance gate (schema v9): the injected
         # dispatch stalls must RAISE the burn, the post-fault clean
         # windows must CLEAR it, and none of it may drop a query
@@ -2288,7 +2414,8 @@ def selftest() -> int:
                      "epochs_per_sec", "cluster_years_per_hour",
                      "degraded_epochs", "recovery", "workload",
                      "pareto", "health", "health_pure",
-                     "resume_timeline_samples")
+                     "resume_timeline_samples", "chaos", "durability",
+                     "overwhelmed", "ref_digest_match")
         } or None,
         "serve": {
             k: v for k, v in (out.get("serve") or {}).items()

@@ -121,6 +121,33 @@ def main(argv: list[str] | None = None) -> int:
                   f"{wl['at_risk_hits']} at-risk hits, "
                   f"{wl['backlog_hits']} backlog hits, "
                   f"{wl['contended_osd_epochs']} contended OSD-epochs")
+        ch = out.get("chaos")
+        if ch:
+            # the correlated-chaos triage table: worst failure domains,
+            # the cascade record, and the repeat offenders — readable
+            # without parsing the digest log
+            print(f"chaos           {ch['cascades']} cascade(s) "
+                  f"(longest {ch['longest_cascade']}), "
+                  f"{ch['hazard_windows']} hazard window(s), "
+                  f"{ch['false_flap_revives']} false-flap revive(s)")
+            if ch.get("domain_outages"):
+                print("  domain outages:")
+                for name, cnt in ch["domain_outages"].items():
+                    print(f"    {name:<12} {cnt}")
+            if ch.get("flap_counts"):
+                print("  flap offenders (designated flappers: "
+                      + ",".join(f"osd.{o}"
+                                 for o in ch["flapper_osds"]) + "):")
+                for name, cnt in ch["flap_counts"].items():
+                    print(f"    {name:<12} {cnt}")
+        dur = out.get("durability")
+        if dur:
+            print(f"durability      pg_lost {dur['pg_lost']}, "
+                  f"{dur['exposed_pg_epochs']} exposed PG-epochs, "
+                  f"{dur['wounded_pgs']} wounded PG(s) "
+                  f"(max {dur['max_wounds']} dead chunks)")
+            for pid, pgs in (dur.get("lost") or {}).items():
+                print(f"  LOST pool {pid}: pgs {pgs}")
         if out.get("pareto"):
             print(f"pareto          {out['pareto']}")
         print(f"trace-once      {out['trace_once']}")

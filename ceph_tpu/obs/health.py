@@ -43,6 +43,10 @@ HEALTH_CHECKS: dict[str, str] = {
     "RECOVERY_BACKLOG": "recovery queue holds unrecovered bytes",
     "SLO_BURN": "serve SLO error budget is burning (see serve/slo.py)",
     "DEVICE_DEGRADED": "runtime fell back to host mapping after device loss",
+    "DATA_LOSS": "PGs lost more chunks than tolerance before recovery "
+                 "drained — irreversible; never auto-clears (raised "
+                 "directly, outside evaluate(), so only an explicit "
+                 "operator clear()/reset() removes it)",
 }
 
 OK = "HEALTH_OK"
@@ -122,7 +126,12 @@ def evaluate(*, osds_down: int = 0, osd_count: int = 0, degraded: int = 0,
              detail: tuple[str, ...] = ()) -> str:
     """Map standard host-side reductions onto the standard checks and
     return the summarized status.  Every argument is a plain int/float
-    the caller already holds — this function is observation only."""
+    the caller already holds — this function is observation only.
+
+    Latched checks (DATA_LOSS) are deliberately NOT evaluated here:
+    `_set` would auto-clear them the first healthy epoch.  Callers
+    raise them directly via `raise_check`, and the returned status
+    still reflects them (status() ranks every raised check)."""
     if not enabled():
         return OK
     _L.inc("evaluations")

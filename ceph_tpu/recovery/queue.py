@@ -330,6 +330,21 @@ class RecoveryQueue:
     def total_backlog(self) -> int:
         return sum(int(b.sum()) for b in self.backlog.values())
 
+    def pg_undrained(self, pid: int, n: int) -> np.ndarray:
+        """Bool [n]: PGs still carrying recovery backlog, from the host
+        mirror (valid after the epoch's drain refreshed it).  The
+        lifetime engine's durability pass keys wound healing off this —
+        a wound may only clear once its PG's backlog was seen and then
+        fully drained."""
+        b = self.backlog.get(pid)
+        if b is None:
+            return np.zeros(n, bool)
+        if b.shape[0] < n:
+            out = np.zeros(n, bool)
+            out[:b.shape[0]] = b > 0
+            return out
+        return b[:n] > 0
+
     # -- the drain ---------------------------------------------------------
 
     def warm(self, pid: int, rows, cap, slots) -> None:

@@ -85,6 +85,10 @@ FAULT_POINTS: dict[str, str] = {
                      "epoch's backlog is touched (qualifier: epoch "
                      "number; `lost` degrades the drain to the "
                      "bit-identical host mirror mid-run)",
+    "hazard_decay": "lifetime-sim correlated-hazard decay step, before "
+                    "the epoch's windows advance (qualifier: epoch "
+                    "number; `fail`/`exit` here kills a run "
+                    "mid-cascade — the hazard-state resume test)",
     "serve_dispatch": "placement-service micro-batch device dispatch "
                       "(qualifier: batch sequence number; `lost` "
                       "degrades the batch to the host mapper, `exit` "

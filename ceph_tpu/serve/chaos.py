@@ -5,7 +5,14 @@ control plane: every epoch it evolves one cluster through a seeded
 failure/churn/growth event as a real `Incremental` chain link.  This
 harness points that churn at a live `PlacementService` — after every
 sim epoch the evolved map swaps into the service — while seeded client
-threads keep a query load running and measure what the *clients* see:
+threads keep a query load running.  When the scenario runs the client
+workload generator (`workload=1`, the default here), those threads
+shape their traffic with the same Zipf/diurnal formulas from
+`sim/workload.py` the simulator scores — hot pools, power-law PG keys,
+a diurnal batch curve — and the default scenario is correlated
+(`correlated=1`): cascading domain outages and repeat-offender
+flappers drive the churn the clients ride through.  Measured, from
+the client side:
 
     p50/p99 request latency UNDER control-plane churn, QPS, shed and
     expired counts, and the never-dropped proof (every submitted
@@ -40,42 +47,83 @@ DEFAULT_CHAOS_SCENARIO = (
     "hosts=4,osds_per_host=3,racks=2,pgs=64,ec=,size=3,"
     "balance_every=8,balance_max=2,spotcheck_every=0,"
     "checkpoint_every=0,seed=23,p_split=0,p_pool_create=0,"
-    "p_expand=0,p_remove=0,workload=1,wl_sample=64"
+    "p_expand=0,p_remove=0,workload=1,wl_sample=64,"
+    "correlated=1,flappers=2"
 )
 
 
 class _Client:
-    """One seeded query-load thread: random pool/seed batches through
-    the full client path, latencies collected for the percentile
-    summary."""
+    """One seeded query-load thread through the full client path,
+    latencies collected for the percentile summary.
+
+    With a workload generator attached (scenario `workload=1`), the
+    thread shapes its traffic with the SAME formulas the simulator
+    scores (sim/workload.py): pools picked by the `(rank+1)^-hot_pool`
+    Zipf rank weights, PG seeds by the `floor(n·u^zipf_a)` hot-key
+    power law, a seeded read/write mix, and a per-iteration batch that
+    rides the diurnal curve — so the degraded reads and SLO burn the
+    service reports happen under the simulator's own correlated
+    scenario, not a uniform stand-in.  Without one, the legacy uniform
+    pool/seed draw is unchanged."""
 
     def __init__(self, svc: PlacementService, seed: int,
-                 batch: int, stop: threading.Event):
+                 batch: int, stop: threading.Event, wl=None):
         self.svc = svc
         self.rng = np.random.default_rng([seed, 0x5e4e])
         self.batch = batch
         self.stop = stop
+        self.wl = wl
+        self.ticks = 0
         self.latencies: list[float] = []
         self.submitted = 0
         self.replied = 0
+        self.reads = 0
         self.by_status: dict[str, int] = {}
         self.thread = threading.Thread(
             target=self._run, name=f"serve-client-{seed}", daemon=True)
+
+    def _draw(self, pools: list[int], n_for) -> tuple[int, np.ndarray]:
+        """One iteration's (pool, seeds) draw in the active traffic
+        model; `n_for(pid)` defers the pg_num read until the pool is
+        chosen (the active map can swap between iterations)."""
+        wl = self.wl
+        if wl is None:
+            pid = int(pools[int(self.rng.integers(len(pools)))])
+            seeds = self.rng.integers(
+                0, n_for(pid), size=self.batch).astype(np.uint32)
+            return pid, seeds
+        from ceph_tpu.sim.workload import pool_rank_weights, zipf_pg_seeds
+
+        cum = np.cumsum(pool_rank_weights(len(pools), wl.hot_pool))
+        j = int(np.searchsorted(cum, self.rng.random() * cum[-1],
+                                side="right"))
+        pid = int(pools[min(j, len(pools) - 1)])
+        # diurnal modulation: the tick index walks the same triangle
+        # curve the simulator's QPS follows, scaled to the batch size
+        eff = self.batch
+        if wl.base_qps > 0:
+            eff = max(1, int(self.batch * wl.qps(self.ticks)
+                             / wl.base_qps))
+        seeds = zipf_pg_seeds(
+            self.rng.random(eff), n_for(pid), wl.zipf_a
+        ).astype(np.uint32)
+        self.reads += int(
+            (self.rng.random(eff) < wl.read_fraction).sum())
+        return pid, seeds
 
     def _run(self) -> None:
         svc = self.svc
         while not self.stop.is_set():
             pools = sorted(svc._active.m.pools)
-            pid = int(pools[int(self.rng.integers(len(pools)))])
-            n = svc._active.m.pools[pid].pg_num
-            seeds = self.rng.integers(0, n, size=self.batch).astype(
-                np.uint32)
+            pid, seeds = self._draw(
+                pools, lambda p: svc._active.m.pools[p].pg_num)
+            self.ticks += 1
             t0 = time.perf_counter()
-            self.submitted += self.batch
+            self.submitted += len(seeds)
             r = svc.lookup_batch(pid, seeds)
-            self.replied += self.batch
+            self.replied += len(seeds)
             self.by_status[r.status] = \
-                self.by_status.get(r.status, 0) + self.batch
+                self.by_status.get(r.status, 0) + len(seeds)
             if r.ok:
                 self.latencies.append(time.perf_counter() - t0)
 
@@ -103,6 +151,20 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
                         else DEFAULT_CHAOS_SCENARIO)
     if epochs is not None:
         sc.epochs = epochs
+    # workload-shaped clients (ROADMAP item 3): when the scenario runs
+    # the client workload generator, the chaos threads draw from the
+    # same Zipf/diurnal formulas — a parameter-only WorkloadGen (no
+    # tallies booked) keeps one source of truth for the shape
+    wl = None
+    if sc.workload:
+        from ceph_tpu.sim.workload import WorkloadGen
+
+        wl = WorkloadGen(
+            seed=sc.seed, base_qps=sc.base_qps,
+            read_fraction=sc.read_fraction, zipf_a=sc.zipf_a,
+            hot_pool=sc.hot_pool, diurnal_amp=sc.diurnal_amp,
+            diurnal_period=sc.diurnal_period, obj_kb=sc.obj_kb,
+            sample=sc.wl_sample, interval_s=sc.interval_s)
     # the serve perf group is process-global; snapshot it so THIS run's
     # shed/expired/degraded tallies are deltas, not whatever an earlier
     # service in the same process (e.g. bench phase A/B) accumulated
@@ -121,7 +183,8 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
                                checkpoint=checkpoint)
     stop = threading.Event()
     pool_threads = [
-        _Client(svc, i, client_batch, stop) for i in range(clients)
+        _Client(svc, i, client_batch, stop, wl=wl)
+        for i in range(clients)
     ]
     t0 = time.perf_counter()
     swaps_ok = swaps_rejected = 0
@@ -189,6 +252,10 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
         "epochs": 0 if sim is None else sim.steps,
         "final_epoch": svc.epoch,
         "wall_s": round(wall, 3),
+        "traffic": "workload" if wl is not None else "uniform",
+        "client_read_mix": round(
+            sum(c.reads for c in pool_threads) / submitted, 3
+        ) if wl is not None and submitted else None,
         "submitted": submitted,
         "replied": replied,
         "dropped": submitted - replied,  # must be 0: never-dropped proof

@@ -23,6 +23,24 @@ module composes every subsystem into one long-running torture test:
   the equality witness: same seed ⇒ same digest, resume ⇒ same final
   digest.
 
+- **Correlated failures (scenario `correlated=1`).**  Three layers on
+  top of the independent draws, every one deterministic and
+  checkpoint-exact: *repeat-offender flappers* (a once-per-lifetime
+  draw marks `flappers` OSDs whose flap-victim weight is multiplied by
+  `flapper_boost`, so the same OSDs flap again and again);
+  *failure-domain hazard windows* (a host/rack outage raises a
+  `cascade_hazard` outage-probability boost on its sibling domains
+  that decays by `cascade_decay` per epoch for `cascade_len` epochs —
+  and while windows are open, outages strike hazarded siblings,
+  producing cascading-rack sequences); and *durability accounting*
+  (true deaths wound every PG that carried the OSD; wounds heal when
+  the PG's recovery backlog drains; a PG wounded past its EC tolerance
+  while un-drained is irreversibly `pg_lost` — folded into the digest
+  line as a `|D` segment, raised as the never-auto-clearing
+  `DATA_LOSS` health check, and exported as timeline exposure series).
+  Flaps and outages revive with their bytes intact (false-positive
+  down-marks); only deaths feed wounds and the recovery queue.
+
 - **Accounting stays device-side, and epoch state is O(delta).**  The
   per-map device operands live in ONE `osd.state.ClusterState` shared
   with the balancer and mgr: epoch deltas apply ON DEVICE in O(delta)
@@ -118,6 +136,16 @@ _L.add_u64("structural_epochs",
 _L.add_u64("spot_checks", "jax==host spot-check lanes compared")
 _L.add_u64("spotcheck_mismatches", "spot-check lanes that disagreed")
 _L.add_u64("checkpoints", "lifetime checkpoints flushed")
+_L.add_u64("cascade_outages",
+           "outages that fired while a sibling-domain hazard window "
+           "was open (correlated model: links of a cascade chain)")
+_L.add_u64("flap_revives",
+           "false-positive down-marks (link flaps) that revived with "
+           "their bytes intact")
+_L.add_u64("pgs_lost",
+           "PGs whose simultaneously-dead chunks exceeded the pool's "
+           "tolerance while their recovery backlog was un-drained — "
+           "irreversible data loss (DATA_LOSS health check)")
 _L.add_avg("at_risk_pg_seconds",
            "integral of the at-risk PG count over simulated seconds "
            "(one observation per epoch)")
@@ -127,6 +155,30 @@ _L.add_quantile("epoch_seconds",
 
 
 # --------------------------------------------------------------- scenario
+
+# The compiled-in chaos-event registry: kind -> what it does.  Keep this
+# a pure dict literal (the graftlint `scenario-event` pass literal_evals
+# it without importing): `Scenario.event_probs()` must walk exactly
+# these kinds, and every kind must be exercised by at least one test —
+# a new event type cannot land untested.
+EVENT_KINDS: dict[str, str] = {
+    "flap": "one OSD marked down transiently; bytes intact, revives "
+            "after flap_len epochs (repeat offenders under correlated)",
+    "death": "one OSD marked down and weighted out permanently; its "
+             "chunks are gone and the recovery queue re-replicates",
+    "remove": "a previously-dead OSD destroyed and pulled from CRUSH",
+    "host_outage": "a whole host bucket's OSDs marked down together; "
+                   "bytes intact, revives after outage_len epochs",
+    "rack_outage": "a whole rack bucket's OSDs marked down together; "
+                   "bytes intact, revives after outage_len epochs",
+    "reweight": "one in OSD's weight nudged (0.6..1.0 of IN_WEIGHT)",
+    "pg_temp": "one PG's acting set rotated via pg_temp/primary_temp, "
+               "cleared after temp_len epochs",
+    "pool_create": "a new replicated pool (up to max_pools)",
+    "split": "one pool's pg_num doubled (up to max_pgs)",
+    "expand": "a new host of osds_per_host OSDs joins CRUSH (up to "
+              "max_expand over the lifetime)",
+}
 
 
 @dataclass
@@ -191,6 +243,15 @@ class Scenario:
     diurnal_period: int = 288
     obj_kb: int = 64         # bytes per modeled object request
     wl_sample: int = 128     # sampled requests per pool per epoch
+    # correlated-failure model (0 = legacy independent draws; spec()
+    # pins the whole block, so a checkpoint can never be resumed under
+    # the other regime and digests never mix)
+    correlated: int = 0
+    flappers: int = 2           # repeat-offender OSDs (drawn once)
+    flapper_boost: float = 8.0  # flap-victim weight for offenders
+    cascade_hazard: float = 0.35  # outage hazard added on siblings
+    cascade_decay: float = 0.6  # per-epoch hazard strength multiplier
+    cascade_len: int = 6        # epochs a hazard window stays open
     # growth limits
     new_pool_pgs: int = 64
     max_pools: int = 6
@@ -247,7 +308,9 @@ class Scenario:
 
     def event_probs(self) -> tuple[tuple[str, float], ...]:
         """(kind, probability) in a FIXED order — the cumulative walk
-        the per-epoch draw runs over (order is part of determinism)."""
+        the per-epoch draw runs over (order is part of determinism).
+        Kinds must match `EVENT_KINDS` exactly (graftlint + the drift
+        test pin both directions)."""
         return (
             ("flap", self.p_flap),
             ("death", self.p_death),
@@ -382,6 +445,9 @@ RECOVERY_DIGEST_KEYS = ("enqueued", "drained", "backlog", "risk_us",
                         "completed")
 WORKLOAD_DIGEST_KEYS = ("requests", "reads", "degraded_reads",
                         "at_risk_hits", "backlog_hits")
+# durability digest fields (correlated model only): per-pool dead-chunk
+# sum, exposed-PG count, and the irreversible lost-PG count
+DURABILITY_DIGEST_KEYS = ("wounds", "exposed", "lost")
 
 
 def _recovery_counters():
@@ -558,6 +624,34 @@ class LifetimeSim:
         self.dead: list[int] = []
         self.host_seq = scenario.hosts
         self.expanded = 0
+        # correlated-failure model state.  Hazard windows are
+        # PATH-DEPENDENT (their decayed strengths depend on when each
+        # outage fired), so they are checkpointed, never recomputed.
+        # [bucket type, bucket id, expire epoch, strength]
+        self.hazards: list[list] = []
+        self.wounded: dict[int, np.ndarray] = {}   # pid -> dead chunks/PG
+        self.healing: dict[int, np.ndarray] = {}   # pid -> repair seen
+        self.lost: dict[int, list[int]] = {}       # pid -> lost seeds
+        self.pg_lost_total = 0
+        self.exposed_pg_epochs = 0
+        self.flap_counts: dict[int, int] = {}
+        self.false_flap_revives = 0
+        self.domain_outages: dict[str, int] = {}
+        self.cascades = 0
+        self.longest_cascade = 0
+        self._cascade_run = 0
+        self.hazard_windows = 0
+        # repeat offenders: a pure function of the scenario (one draw
+        # per LIFETIME, not per epoch), so resume recomputes the same
+        # set and the per-epoch rng stream stays untouched by it
+        self.flapper_osds: list[int] = []
+        if scenario.correlated and scenario.flappers > 0:
+            n0 = scenario.hosts * scenario.osds_per_host
+            pick = np.random.default_rng(
+                [scenario.seed, 0xF1A9]).choice(
+                n0, size=min(scenario.flappers, n0), replace=False)
+            self.flapper_osds = sorted(int(o) for o in pick)
+        self._flapper_set = set(self.flapper_osds)
         self.resumed_from: int | None = None
         # in-process caches (never checkpointed: cache state, not truth).
         # self.state is the device-resident ClusterState (jax backend):
@@ -654,6 +748,27 @@ class LifetimeSim:
             "dead": self.dead,
             "host_seq": self.host_seq,
             "expanded": self.expanded,
+            # hazard windows carry their CURRENT decayed strengths:
+            # resume must continue the decay curve, not restart it
+            # (json round-trips float64 exactly)
+            "hazards": [list(h) for h in self.hazards],
+            "wounded": {str(pid): [int(x) for x in w]
+                        for pid, w in self.wounded.items()},
+            "healing": {str(pid): [int(x) for x in h]
+                        for pid, h in self.healing.items()},
+            "lost": {str(pid): list(s) for pid, s in self.lost.items()},
+            "pg_lost_total": self.pg_lost_total,
+            "exposed_pg_epochs": self.exposed_pg_epochs,
+            "chaos": {
+                "flap_counts": {str(k): v
+                                for k, v in self.flap_counts.items()},
+                "false_flap_revives": self.false_flap_revives,
+                "domain_outages": dict(self.domain_outages),
+                "cascades": self.cascades,
+                "longest_cascade": self.longest_cascade,
+                "cascade_run": self._cascade_run,
+                "hazard_windows": self.hazard_windows,
+            },
             "map_b64": base64.b64encode(
                 encode_osdmap(self.m)).decode(),
             "recovery": (None if self.recovery is None
@@ -696,6 +811,25 @@ class LifetimeSim:
         self.dead = list(state["dead"])
         self.host_seq = int(state["host_seq"])
         self.expanded = int(state["expanded"])
+        self.hazards = [list(h) for h in state.get("hazards", [])]
+        self.wounded = {int(k): np.asarray(v, np.int64)
+                        for k, v in (state.get("wounded") or {}).items()}
+        self.healing = {int(k): np.asarray(v, bool)
+                        for k, v in (state.get("healing") or {}).items()}
+        self.lost = {int(k): [int(s) for s in v]
+                     for k, v in (state.get("lost") or {}).items()}
+        self.pg_lost_total = int(state.get("pg_lost_total", 0))
+        self.exposed_pg_epochs = int(state.get("exposed_pg_epochs", 0))
+        cz = state.get("chaos") or {}
+        self.flap_counts = {
+            int(k): int(v)
+            for k, v in (cz.get("flap_counts") or {}).items()}
+        self.false_flap_revives = int(cz.get("false_flap_revives", 0))
+        self.domain_outages = dict(cz.get("domain_outages") or {})
+        self.cascades = int(cz.get("cascades", 0))
+        self.longest_cascade = int(cz.get("longest_cascade", 0))
+        self._cascade_run = int(cz.get("cascade_run", 0))
+        self.hazard_windows = int(cz.get("hazard_windows", 0))
         if self.recovery is not None and state.get("recovery"):
             self.recovery.restore(state["recovery"])
         if self.workload is not None and state.get("workload"):
@@ -938,6 +1072,9 @@ class LifetimeSim:
                 del self._prev_rows[pid]
                 self._stats_cache.pop(pid, None)
                 self._moved.pop(pid, None)
+                self.wounded.pop(pid, None)
+                self.healing.pop(pid, None)
+                self.lost.pop(pid, None)  # pg_lost_total stays booked
                 if self.recovery is not None:
                     self.recovery.drop(pid)
         return stats, frozenset(skeys)
@@ -1076,6 +1213,28 @@ class LifetimeSim:
             reverse=True,
         )
 
+    def _sibling_domains(self, bid: int, type_: int) -> list[int]:
+        """The failure domains a bucket's outage raises hazard on: the
+        other same-type buckets under the same (non-shadow) parent —
+        hosts sharing a rack, racks sharing the root.  Falls back to
+        every other same-type bucket when no parent carries siblings
+        (flat hierarchies)."""
+        pool = self._buckets_of_type(type_)
+        shadows = {
+            sid for per in self.m.crush.class_bucket.values()
+            for sid in per.values()
+        }
+        parent = next(
+            (pb for pb, b in self.m.crush.buckets.items()
+             if bid in b.items and pb not in shadows), None)
+        sibs: list[int] = []
+        if parent is not None:
+            inside = set(self.m.crush.buckets[parent].items)
+            sibs = [b for b in pool if b in inside and b != bid]
+        if not sibs:
+            sibs = [b for b in pool if b != bid]
+        return sibs
+
     def _floor(self) -> int:
         return max((p.size for p in self.m.pools.values()), default=3)
 
@@ -1083,10 +1242,37 @@ class LifetimeSim:
         return [o for o in range(self.m.max_osd)
                 if self.m.is_up(o) and o not in exclude]
 
+    def _hazard_boost(self) -> dict[int, float]:
+        """Summed live hazard strength per bucket type (1=host,
+        3=rack) — the correlation mass added to the outage draws."""
+        add: dict[int, float] = {}
+        for t, _bid, _exp, s in self.hazards:
+            add[t] = add.get(t, 0.0) + float(s)
+        return add
+
+    def _decay_hazards(self, e: int) -> None:
+        """Advance every open hazard window by one epoch: strength
+        decays geometrically, expired/vanished windows close.  Runs
+        exactly once per epoch (before the kind draw), and the decayed
+        strengths are checkpointed — a resume continues the curve."""
+        faults.check("hazard_decay", qual=str(e))
+        kept: list[list] = []
+        for rec in self.hazards:
+            rec[3] = float(rec[3]) * self.scenario.cascade_decay
+            if rec[2] > e and rec[3] >= 1e-9:
+                kept.append(rec)
+        self.hazards = kept
+
     def _draw_kind(self, rng) -> str:
         u = float(rng.random())
+        boost = self._hazard_boost() if (
+            self.scenario.correlated and self.hazards) else {}
         acc = 0.0
         for kind, p in self.scenario.event_probs():
+            if kind == "host_outage":
+                p += boost.get(1, 0.0)
+            elif kind == "rack_outage":
+                p += boost.get(3, 0.0)
             acc += p
             if u < acc:
                 return kind
@@ -1099,6 +1285,9 @@ class LifetimeSim:
         notes: list[str] = []
         touched: set[int] = set()
 
+        if sc.correlated:
+            self._decay_hazards(e)
+
         # transient expiries ride the same epoch delta
         for osd in sorted(o for o, t in self.flap_down.items()
                           if t <= e):
@@ -1106,6 +1295,11 @@ class LifetimeSim:
             if m.exists(osd) and m.is_down(osd):
                 inc.new_state[osd] = OSD_UP
                 touched.add(osd)
+                # a flap revive is the false-positive-down story: the
+                # OSD comes back with every byte intact (no recovery
+                # enqueue ever happened for it)
+                self.false_flap_revives += 1
+                _L.inc("flap_revives")
                 notes.append(f"revive osd.{osd}")
         for rec in [r for r in self.outages if r[0] <= e]:
             self.outages.remove(rec)
@@ -1175,7 +1369,22 @@ class LifetimeSim:
         if kind == "flap":
             if len(ups) - 1 < floor or not ups:
                 return quiet("flap:floor")
-            osd = int(ups[int(rng.integers(len(ups)))])
+            if sc.correlated and self._flapper_set:
+                # repeat offenders: the once-per-lifetime flakiness
+                # multipliers weight the victim draw, so the same OSDs
+                # flap again and again (cumulative-sum draw, exact
+                # float64 — identical on every backend and on resume)
+                w = np.asarray(
+                    [sc.flapper_boost if o in self._flapper_set
+                     else 1.0 for o in ups], np.float64)
+                cum = np.cumsum(w)
+                u = float(rng.random()) * float(cum[-1])
+                idx = min(int(np.searchsorted(cum, u, side="right")),
+                          len(ups) - 1)
+                osd = int(ups[idx])
+            else:
+                osd = int(ups[int(rng.integers(len(ups)))])
+            self.flap_counts[osd] = self.flap_counts.get(osd, 0) + 1
             inc.new_state[osd] = OSD_UP
             self.flap_down[osd] = e + 1 + int(
                 rng.integers(1, sc.flap_len + 1))
@@ -1188,6 +1397,8 @@ class LifetimeSim:
             inc.new_state[osd] = OSD_UP
             inc.new_weight[osd] = 0
             self.dead.append(osd)
+            if sc.correlated:
+                self._wound_osd(osd)
             return kind, f"death osd.{osd}"
 
         if kind == "remove":
@@ -1209,6 +1420,15 @@ class LifetimeSim:
             buckets = self._buckets_of_type(type_)
             if not buckets:
                 return quiet(f"{kind}:no-bucket")
+            if sc.correlated:
+                # cascade bias: while hazard windows of this type are
+                # open, the outage strikes a hazarded sibling domain —
+                # that is what turns one rack outage into a sequence
+                hot = {int(h[1]) for h in self.hazards
+                       if h[0] == type_}
+                hazarded = [b for b in buckets if b in hot]
+                if hazarded:
+                    buckets = hazarded
             bid = int(buckets[int(rng.integers(len(buckets)))])
             victims = [o for o in self._devices_under(bid)
                        if m.is_up(o) and o not in touched]
@@ -1221,6 +1441,25 @@ class LifetimeSim:
                 victims,
             ])
             name = m.crush.item_names.get(bid, str(bid))
+            self.domain_outages[name] = \
+                self.domain_outages.get(name, 0) + 1
+            if sc.correlated:
+                if self.hazards:
+                    # fired inside an open window: one more link of the
+                    # current cascade chain
+                    self.cascades += 1
+                    self._cascade_run += 1
+                    _L.inc("cascade_outages")
+                else:
+                    self._cascade_run = 1
+                self.longest_cascade = max(self.longest_cascade,
+                                           self._cascade_run)
+                for sib in self._sibling_domains(bid, type_):
+                    self.hazards.append([
+                        type_, int(sib), e + 1 + sc.cascade_len,
+                        float(sc.cascade_hazard),
+                    ])
+                    self.hazard_windows += 1
             return kind, f"{kind} {name} osds={victims}"
 
         if kind == "reweight":
@@ -1505,6 +1744,117 @@ class LifetimeSim:
         self._cap_rem = None
         return {"per_pool": per_pool, "backlog_total": total}
 
+    # -- durability accounting (correlated model) --------------------------
+
+    def _wounds(self, pid: int, n: int) -> np.ndarray:
+        """The pool's per-PG simultaneously-dead-chunk counts, grown
+        with zeros on splits (parent seeds keep their wounds, children
+        start whole — mirroring RecoveryQueue.ensure)."""
+        w = self.wounded.get(pid)
+        if w is None or w.shape[0] < n:
+            grown = np.zeros(n, np.int64)
+            if w is not None:
+                grown[:w.shape[0]] = w
+            self.wounded[pid] = w = grown
+        return w
+
+    def _heal_flags(self, pid: int, n: int) -> np.ndarray:
+        """Per-PG 'repair observed' flags: a wound may only heal after
+        its PG's repair was seen running — lanes moved or backlog held
+        — so a hole PG (CRUSH found no spare target, nothing enqueued,
+        queue trivially quiet) stays wounded until the cluster actually
+        remaps it."""
+        h = self.healing.get(pid)
+        if h is None or h.shape[0] < n:
+            grown = np.zeros(n, bool)
+            if h is not None:
+                grown[:h.shape[0]] = h
+            self.healing[pid] = h = grown
+        return h
+
+    def _wound_osd(self, osd: int) -> None:
+        """Chunk-loss bookkeeping for a true death: every PG whose
+        current up set carries the OSD has one more simultaneously-dead
+        chunk.  Flaps and outages never come here — their bytes revive
+        intact.  Pure host work on the already-resident rows (the
+        np.asarray on a device array is a transfer, never a compile),
+        and death epochs are never steady anyway."""
+        for pid in sorted(self.m.pools):
+            ent = self._prev_rows.get(pid)
+            if ent is None:
+                continue
+            rows = np.asarray(ent[1])
+            n = min(self.m.pools[pid].pg_num, rows.shape[0])
+            hit = (rows[:n] == osd).any(axis=1)
+            if hit.any():
+                self._wounds(pid, n)[:n][hit] += 1
+
+    def _durability_epoch(self, e: int) -> dict:
+        """Post-recovery durability pass (exact host ints on every
+        backend — the |D digest segment hangs off these).  A wound
+        heals once its PG's repair was OBSERVED — lanes moved (the
+        remap that re-replicates the dead chunk) or backlog held — and
+        the backlog has drained to zero: redundancy restored.  A
+        concurrent outage hiding intact replicas of the same PG never
+        blocks the heal (those bytes revive); a hole PG whose repair
+        never started stays wounded however long its queue is quiet.
+        A PG whose wounds exceed the pool's tolerance before its
+        repair drains is irreversibly LOST.  The np.asarray on a
+        wounded pool's device arrays is a transfer, never a compile —
+        and only wounded pools pay it."""
+        rq = self.recovery
+        per_pool: dict[int, dict] = {}
+        exposed_total = 0
+        for pid in sorted(self.m.pools):
+            pool = self.m.pools[pid]
+            n = pool.pg_num
+            w = self._wounds(pid, n)
+            wnz = w[:n] > 0
+            if wnz.any() and rq is not None:
+                heal = self._heal_flags(pid, n)
+                undrained = rq.pg_undrained(pid, n)
+                repairing = undrained.copy()
+                moved = self._moved.get(pid)
+                if moved is not None:
+                    mv = np.asarray(moved)
+                    k = min(n, mv.shape[0])
+                    repairing[:k] |= mv[:k] > 0
+                heal[:n][wnz & repairing] = True
+                done = wnz & heal[:n] & ~undrained
+                w[:n][done] = 0
+                heal[:n][done] = False
+                wnz = w[:n] > 0
+            tol = self._pool_tolerance(pool)
+            lost = self.lost.setdefault(pid, [])
+            lmask = np.zeros(n, bool)
+            if lost:
+                lmask[np.asarray([s for s in lost if s < n],
+                                 np.int64)] = True
+            newly = (w[:n] > tol) & ~lmask
+            if newly.any():
+                lost.extend(int(s) for s in np.nonzero(newly)[0])
+                lost.sort()
+                k = int(newly.sum())
+                self.pg_lost_total += k
+                _L.inc("pgs_lost", k)
+                _log(0, f"epoch {e}: pool {pid} lost {k} PG(s) — dead "
+                        f"chunks exceeded tolerance {tol} before the "
+                        "backlog drained")
+            if rq is None:
+                # flat model: recovery completes within the stretched
+                # epoch by construction, so surviving wounds heal now
+                w[:n] = 0
+                wnz = w[:n] > 0
+            exposed = int(wnz.sum())
+            exposed_total += exposed
+            per_pool[pid] = {
+                "wounds": int(w[:n].sum()),
+                "exposed": exposed,
+                "lost": len(lost),
+            }
+        self.exposed_pg_epochs += exposed_total
+        return {"per_pool": per_pool, "exposed": exposed_total}
+
     # -- the step ----------------------------------------------------------
 
     def _overlay_presence(self) -> tuple:
@@ -1538,6 +1888,8 @@ class LifetimeSim:
                   if self.workload is not None else None)
             rec = (self._recovery_epoch(e, stats)
                    if self.recovery is not None else None)
+            dur = (self._durability_epoch(e)
+                   if self.scenario.correlated else None)
             epoch_s = self._integrate(stats, rec)
             self._invariants(e, rng, stats)
         jd = obs.jit_counters_delta(jit0)
@@ -1585,6 +1937,13 @@ class LifetimeSim:
                     for k in WORKLOAD_DIGEST_KEYS))
                 for pid in sorted(wl["per_pool"])
             ) + f"|C{wl['throttled']}:{wl['contended']}"
+        if dur is not None:
+            line += "|D" + ";".join(
+                "{}:{}".format(pid, ":".join(
+                    str(dur["per_pool"][pid][k])
+                    for k in DURABILITY_DIGEST_KEYS))
+                for pid in sorted(dur["per_pool"])
+            ) + f"|L{self.pg_lost_total}"
         self.digest = hashlib.sha256(
             (self.digest + line).encode()).hexdigest()
         self.steps = e
@@ -1596,7 +1955,8 @@ class LifetimeSim:
         # observation AFTER the digest update: health/timeline read only
         # the host ints accounting already fetched, so enabling them is
         # bit-invisible to the replay digest by construction
-        health_status = self._observe_epoch(e, stats, rec, wl, structural)
+        health_status = self._observe_epoch(e, stats, rec, wl, dur,
+                                            structural)
         every = self.scenario.checkpoint_every
         if self.ck is not None and every and e % every == 0:
             self._checkpoint()
@@ -1611,7 +1971,8 @@ class LifetimeSim:
         }
 
     def _observe_epoch(self, e: int, stats: dict, rec: dict | None,
-                       wl: dict | None, structural: bool) -> str:
+                       wl: dict | None, dur: dict | None,
+                       structural: bool) -> str:
         """Pure-observer tail of step(): evaluate the health checks and
         record the "sim" timeline sample from numbers that already
         crossed the device boundary.  No device work, no digest input —
@@ -1626,6 +1987,17 @@ class LifetimeSim:
         backlog_gb = (rec["backlog_total"] / 1e9) if rec else 0.0
         status = health.OK
         if health.enabled():
+            if self.pg_lost_total > 0:
+                # raised DIRECTLY, outside evaluate()'s auto-clearing
+                # _set machinery: data loss is irreversible, so
+                # DATA_LOSS never clears on its own — only an explicit
+                # operator reset/clear removes it
+                health.raise_check(
+                    "DATA_LOSS", health.ERR,
+                    f"{self.pg_lost_total} PG(s) suffered unrecoverable"
+                    " chunk loss (dead chunks exceeded tolerance before"
+                    " the backlog drained)",
+                    count=self.pg_lost_total)
             exists = down = 0
             for o in range(self.m.max_osd):
                 if self.m.exists(o):
@@ -1651,6 +2023,10 @@ class LifetimeSim:
             "throttled": (wl or {}).get("throttled", 0),
             "structural": int(structural),
             "health": health.rank(status),
+            # durability exposure: PGs currently below full redundancy
+            # from true chunk deaths, and the irreversible loss count
+            "exposed": 0 if dur is None else dur["exposed"],
+            "pg_lost": self.pg_lost_total,
         })
         return status
 
@@ -1765,6 +2141,35 @@ class LifetimeSim:
             "workload": (None if self.workload is None
                          else self.workload.summary(self.sim_seconds)),
         }
+        if self.scenario.correlated:
+            worst = sorted(self.flap_counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+            out["chaos"] = {
+                "flapper_osds": list(self.flapper_osds),
+                "flap_counts": {f"osd.{o}": c for o, c in worst[:8]},
+                "repeat_flaps": max(self.flap_counts.values(),
+                                    default=0),
+                "false_flap_revives": self.false_flap_revives,
+                "domain_outages": dict(sorted(
+                    self.domain_outages.items(),
+                    key=lambda kv: (-kv[1], kv[0]))),
+                "cascades": self.cascades,
+                "longest_cascade": self.longest_cascade,
+                "hazard_windows": self.hazard_windows,
+                "active_hazards": len(self.hazards),
+            }
+            out["durability"] = {
+                "pg_lost": self.pg_lost_total,
+                "lost": {str(pid): list(s)
+                         for pid, s in sorted(self.lost.items()) if s},
+                "exposed_pg_epochs": self.exposed_pg_epochs,
+                "wounded_pgs": int(sum(
+                    int((w > 0).sum())
+                    for w in self.wounded.values())),
+                "max_wounds": int(max(
+                    (int(w.max()) for w in self.wounded.values()
+                     if w.size), default=0)),
+            }
         if self.workload is not None:
             # the pareto headline: simulated coverage rate AT a stated
             # client service level (with the recovery backlog the queue

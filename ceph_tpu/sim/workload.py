@@ -76,6 +76,22 @@ WL_KEYS = ("requests", "reads", "writes", "degraded_reads",
            "at_risk_hits", "backlog_hits", "unserved")
 
 
+def zipf_pg_seeds(u: np.ndarray, n: int, zipf_a: float) -> np.ndarray:
+    """The hot-key power-law PG draw: `floor(n · u^a)` clamped to
+    [0, n).  Shared by the simulator's per-epoch samples and the serve
+    chaos clients so both sides of ROADMAP item 3 shape traffic with
+    the SAME formula."""
+    return np.minimum((n * np.power(u, zipf_a)).astype(np.int64), n - 1)
+
+
+def pool_rank_weights(k: int, hot_pool: float) -> list[float]:
+    """Zipf-like rank weights across `k` pools (`(rank+1)^-hot_pool`,
+    hottest first).  A plain Python list summed left-to-right — the
+    exact arithmetic `pool_requests` always used, so extracting it
+    moved no digests."""
+    return [(i + 1) ** -hot_pool for i in range(k)]
+
+
 def workload_pool_np(rows, backlog, seeds, read, *, wq: int,
                      obj_bytes: int, DV: int, size: int, tol: int):
     """The authoritative per-pool traffic formula, numpy executor
@@ -237,7 +253,7 @@ class WorkloadGen:
         """Zipf-rank split of the epoch's requests across pools (pool
         rank = position in sorted pid order: oldest pool hottest)."""
         R = self.epoch_requests(e)
-        w = [(i + 1) ** -self.hot_pool for i in range(len(pids))]
+        w = pool_rank_weights(len(pids), self.hot_pool)
         tot = sum(w)
         return {pid: int(R * wi / tot) for pid, wi in zip(pids, w)}
 
@@ -246,8 +262,7 @@ class WorkloadGen:
         PG seeds + the read/write mix."""
         rng = np.random.default_rng([self.seed, e, pid, 0x77])
         u = rng.random(self.sample)
-        seeds = np.minimum(
-            (n * np.power(u, self.zipf_a)).astype(np.int64), n - 1)
+        seeds = zipf_pg_seeds(u, n, self.zipf_a)
         read = rng.random(self.sample) < self.read_fraction
         return seeds, read
 

@@ -122,6 +122,11 @@ def test_seeded_regressions_flagged():
         "serve.health.rank",                   # HEALTH_OK -> HEALTH_WARN
         "serve.slo.burns_cleared",             # 1 -> 0: burn never cleared
         "serve.slo.breaches",                  # 6 -> 94
+        # correlated durability (v10, seeded in r17->r18): the default
+        # scenario is sized survivable, so pg_lost appearing from zero
+        # and the exposure blow-up are semantic drift, compared raw
+        "lifetime.durability.pg_lost",         # 0 -> 3: DATA LOSS
+        "lifetime.durability.exposed_pg_epochs",  # 61 -> 188
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -251,6 +256,45 @@ def test_health_slo_fixture_pair_v9():
     assert not any(
         d["metric"].startswith(("lifetime.health", "serve.slo.",
                                 "serve.health"))
+        for d in rep2["regressions"])
+
+
+def test_durability_fixture_pair_v10():
+    """The v10 seeded pair in isolation: the survivable correlated
+    round (r17, pg_lost 0) against the durability regression (r18,
+    pg_lost 3).  pg_lost rides the structural zero-baseline rule —
+    there is no relative change from 0, so the threshold cannot
+    arbitrate, and a loss appearing at all must flag."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r17"], by["r18"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    assert "lifetime.durability.pg_lost" in flagged
+    d = flagged["lifetime.durability.pg_lost"]
+    assert not d["normalized"]          # structural: raw
+    assert d["prev"] == 0 and d["cur"] == 3
+    assert d["change"] is None          # zero baseline: no finite pct
+    assert "lifetime.durability.exposed_pg_epochs" in flagged
+    # the healthy record alone extracts the full v10 shape
+    m = extract_metrics(by["r17"].record)
+    assert m["lifetime.durability.pg_lost"][0] == 0.0
+    assert m["lifetime.chaos.cascades"][0] == 3
+    assert m["lifetime.chaos.false_flap_revives"][0] == 9
+    assert m["lifetime.overwhelmed.pg_lost"][0] == 4
+    assert m["lifetime.overwhelmed.data_loss_latched"][0] == 1.0
+    assert m["lifetime.ref_digest_match"][0] == 1.0
+    # every v10 metric is structural (raw compare)
+    for name, (_, _, cal) in m.items():
+        if name.startswith(("lifetime.chaos.", "lifetime.durability.",
+                            "lifetime.overwhelmed.")):
+            assert not cal, name
+    # the healthy direction (r16 regression recovering into r17) never
+    # flags a chaos/durability metric
+    rep2 = diff_series([by["r16"], by["r17"]])
+    assert not any(
+        d["metric"].startswith(("lifetime.chaos.",
+                                "lifetime.durability.",
+                                "lifetime.overwhelmed."))
         for d in rep2["regressions"])
 
 

@@ -1,0 +1,348 @@
+"""Correlated-failure chaos engine: repeat-offender flappers, cascading
+failure-domain hazards, false-flap revives vs true deaths, and the
+per-PG dead-chunk durability ledger (sim/lifetime.py `correlated=1`).
+
+Tier-1 keeps every scenario tiny and on the host ("ref") backend; the
+acceptance-scale 510-epoch run lives in `bench.py --selftest`.  The
+quiet-probability overrides (`_QUIET`) zero every event class so a
+forced event's aftermath replays deterministically with no chance
+chaos on top.
+"""
+
+from __future__ import annotations
+
+from dataclasses import fields
+
+import numpy as np
+import pytest
+
+from ceph_tpu import obs
+from ceph_tpu.runtime import faults
+from ceph_tpu.sim.lifetime import (
+    EVENT_KINDS,
+    LifetimeSim,
+    Scenario,
+)
+
+# small but complete: EC 2+1 (tolerance 1) + replicated pool, queue
+# recovery with a pipe fast enough that a lone wound heals in a few
+# epochs, both correlated layers on
+CORR = ("epochs=16,seed=11,hosts=4,osds_per_host=3,racks=2,pgs=32,"
+        "ec=2+1,ec_pgs=16,chunk=256,balance_every=0,spotcheck_every=0,"
+        "checkpoint_every=0,recovery=queue,max_backfills=4,"
+        "recovery_mbps=200,osd_mbps=400,correlated=1,flappers=2")
+
+# zero every event probability: forced events only, quiet aftermath
+_QUIET = (",p_flap=0,p_death=0,p_remove=0,p_host_outage=0,"
+          "p_rack_outage=0,p_reweight=0,p_pg_temp=0,p_pool_create=0,"
+          "p_split=0,p_expand=0")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.health.reset()
+    yield
+    faults.disarm_all()
+    obs.health.reset()
+
+
+# ------------------------------------------------------ scenario grammar
+
+
+def test_scenario_spec_covers_every_field():
+    """Drift guard: spec() must render EVERY Scenario field (a field
+    missing from spec() would silently unpin it from the checkpoint's
+    same-scenario guard and from the README grammar table)."""
+    sc = Scenario.parse(None)
+    items = sc.spec().split(",")
+    for f in fields(Scenario):
+        assert f"{f.name}={getattr(sc, f.name)}" in items, f.name
+
+
+def test_readme_grammar_table_covers_every_field():
+    """The README scenario-grammar table documents every field as a
+    `| `key` | ... |` row — same convention the knob table test pins."""
+    import pathlib
+
+    readme = (pathlib.Path(__file__).resolve().parents[1]
+              / "README.md").read_text()
+    for f in fields(Scenario):
+        assert f"| `{f.name}` |" in readme, (
+            f"{f.name} missing from README scenario-grammar table")
+
+
+def test_scenario_correlated_block_roundtrips():
+    sc = Scenario.parse(
+        "correlated=1,flappers=3,flapper_boost=2.5,cascade_hazard=0.5,"
+        "cascade_decay=0.9,cascade_len=4")
+    assert sc.correlated == 1 and sc.flappers == 3
+    assert sc.cascade_decay == 0.9
+    assert Scenario.parse(sc.spec()) == sc
+
+
+def test_event_kinds_match_event_probs():
+    """Both directions of the vocabulary contract (the static mirror of
+    graftlint's scenario-event pass)."""
+    kinds = [k for k, _ in Scenario().event_probs()]
+    assert len(kinds) == len(set(kinds))
+    assert sorted(kinds) == sorted(EVENT_KINDS)
+
+
+# --------------------------------------------------------- determinism
+
+
+def test_correlated_digest_deterministic_and_regime_segregated():
+    a = LifetimeSim(Scenario.parse(CORR), backend="ref").run()
+    b = LifetimeSim(Scenario.parse(CORR), backend="ref").run()
+    assert a["digest"] == b["digest"]
+    assert a["invariant_violations"] == 0
+    assert "chaos" in a and "durability" in a
+    # the legacy regime must not share digests with the correlated one
+    legacy = LifetimeSim(Scenario.parse(CORR + ",correlated=0"),
+                         backend="ref").run()
+    assert legacy["digest"] != a["digest"]
+    assert "chaos" not in legacy and "durability" not in legacy
+
+
+# ------------------------------------------- flappers / hazards / revive
+
+
+def test_flappers_drawn_once_per_lifetime():
+    """The repeat-offender draw is a pure function of the scenario —
+    two engines agree, and the draw never exceeds the initial OSD
+    count."""
+    a = LifetimeSim(Scenario.parse(CORR), backend="ref")
+    b = LifetimeSim(Scenario.parse(CORR), backend="ref")
+    assert a.flapper_osds == b.flapper_osds
+    assert len(a.flapper_osds) == 2
+    assert all(0 <= o < 12 for o in a.flapper_osds)
+    # legacy regime draws no offenders
+    c = LifetimeSim(Scenario.parse(CORR + ",correlated=0"),
+                    backend="ref")
+    assert c.flapper_osds == []
+
+
+def test_rack_outage_opens_decaying_hazard_windows():
+    sc = Scenario.parse(CORR + _QUIET)
+    sim = LifetimeSim(sc, backend="ref")
+    sim.step(force_event="rack_outage")
+    assert sim.hazard_windows >= 1
+    assert sim.hazards, "rack outage opened no sibling hazard window"
+    assert any(k.startswith("rack") for k in sim.domain_outages)
+    before = {(h[0], h[1], h[2]): h[3] for h in sim.hazards}
+    sim.step()  # quiet epoch: strengths decay, nothing new opens
+    after = {(h[0], h[1], h[2]): h[3] for h in sim.hazards}
+    for key, s1 in after.items():
+        s0 = before[key]
+        assert s1 == pytest.approx(s0 * sc.cascade_decay, rel=1e-9)
+    # windows expire after cascade_len epochs
+    for _ in range(sc.cascade_len + 1):
+        sim.step()
+    assert sim.hazards == []
+    assert sim.violations == []
+
+
+def test_false_flap_revive_keeps_bytes_intact():
+    """A flap is a false-positive down-mark: the OSD revives with its
+    bytes, the revive is counted, and the durability ledger never
+    records a dead chunk for it."""
+    sc = Scenario.parse(CORR + _QUIET + ",flap_len=2,epochs=12")
+    sim = LifetimeSim(sc, backend="ref")
+    sim.step(force_event="flap")
+    for _ in range(sc.flap_len + 2):
+        sim.step()
+    assert sim.false_flap_revives >= 1
+    assert all((w == 0).all() for w in sim.wounded.values())
+    assert sim.pg_lost_total == 0
+    assert sim.violations == []
+
+
+# ------------------------------------------------------------ durability
+
+
+def test_true_death_wounds_then_recovery_heals():
+    """A real death wounds every PG that carried the OSD; the recovery
+    queue drains the re-replication and the wounds heal — exposure was
+    recorded, nothing was lost.  The pipe is slowed so the wound
+    survives at least one epoch (the fast default heals inside the
+    death epoch and records no exposure)."""
+    sc = Scenario.parse(CORR + _QUIET + ",epochs=30,max_backfills=1,"
+                        "recovery_mbps=20,osd_mbps=40")
+    sim = LifetimeSim(sc, backend="ref")
+    sim.step(force_event="death")
+    for _ in range(12):
+        if all((w == 0).all() for w in sim.wounded.values()):
+            break
+        sim.step()
+    assert all((w == 0).all() for w in sim.wounded.values()), \
+        "wounds never healed on a fast recovery pipe"
+    assert sim.exposed_pg_epochs > 0, "no exposure recorded for a death"
+    assert sim.pg_lost_total == 0
+    assert sim.violations == []
+
+
+def test_overwhelming_death_rate_loses_pgs_and_latches_data_loss():
+    """The loss path: a starved pipe under a brutal death rate stacks
+    dead chunks past EC tolerance — pg_lost fires, DATA_LOSS latches at
+    HEALTH_ERR, and a later all-clear evaluate() does NOT clear it
+    (data loss is not a condition that heals; only an explicit
+    operator clear() acknowledges it)."""
+    sc = Scenario.parse(
+        "epochs=14,hosts=3,osds_per_host=2,racks=1,pgs=16,ec=2+1,"
+        "ec_pgs=8,chunk=64,seed=7,p_death=0.25,p_flap=0.05,"
+        "p_host_outage=0.10,p_reweight=0,p_pg_temp=0,p_pool_create=0,"
+        "p_split=0,p_expand=0,p_remove=0.02,balance_every=0,"
+        "spotcheck_every=0,checkpoint_every=0,recovery=queue,"
+        "max_backfills=1,recovery_mbps=2,osd_mbps=4,correlated=1,"
+        "flappers=1")
+    out = LifetimeSim(sc, backend="ref").run()
+    assert out["durability"]["pg_lost"] > 0
+    assert out["durability"]["lost"], "lost map empty with pg_lost > 0"
+    chk = obs.health.checks().get("DATA_LOSS")
+    assert chk and chk["severity"] == obs.health.ERR
+    # standard evaluation may clear its own codes, never the latch
+    obs.health.evaluate()
+    assert "DATA_LOSS" in obs.health.checks()
+    assert obs.health.status() == obs.health.ERR
+    obs.health.clear("DATA_LOSS")  # the explicit operator ack
+    assert "DATA_LOSS" not in obs.health.checks()
+
+
+def test_lost_pgs_never_unlose_on_later_heal():
+    """`lost` is irreversible: once a PG's dead chunks exceeded
+    tolerance, a later drained backlog must not shrink pg_lost."""
+    sc = Scenario.parse(
+        "epochs=14,hosts=3,osds_per_host=2,racks=1,pgs=16,ec=2+1,"
+        "ec_pgs=8,chunk=64,seed=7,p_death=0.25,p_flap=0.05,"
+        "p_host_outage=0.10,p_reweight=0,p_pg_temp=0,p_pool_create=0,"
+        "p_split=0,p_expand=0,p_remove=0.02,balance_every=0,"
+        "spotcheck_every=0,checkpoint_every=0,recovery=queue,"
+        "max_backfills=1,recovery_mbps=2,osd_mbps=4,correlated=1,"
+        "flappers=1")
+    sim = LifetimeSim(sc, backend="ref")
+    peak = 0
+    for _ in range(sc.epochs):
+        sim.step()
+        assert sim.pg_lost_total >= peak
+        peak = max(peak, sim.pg_lost_total)
+    assert peak > 0
+
+
+# ------------------------------------------------------ resume contracts
+
+
+def test_resume_mid_cascade_pins_hazard_state(tmp_path):
+    """Kill during an active outage window: the checkpoint carries the
+    decayed hazard strengths (path-dependent state — recomputing them
+    would fork the trajectory), and the resumed run lands on the
+    straight run's digest."""
+    sc = Scenario.parse(CORR + ",epochs=14,checkpoint_every=2,"
+                        "p_host_outage=0.3,p_rack_outage=0.1")
+    straight = LifetimeSim(Scenario.parse(sc.spec()),
+                           backend="ref").run()
+
+    # find the first epoch (seeded, so deterministic) with open windows
+    probe = LifetimeSim(Scenario.parse(sc.spec()), backend="ref")
+    stop = None
+    for e in range(1, sc.epochs - 2):
+        probe.step()
+        if probe.hazards:
+            stop = e
+            break
+    assert stop is not None, "scenario opened no hazard window"
+
+    ck = tmp_path / "ck.json"
+    a = LifetimeSim(Scenario.parse(sc.spec()), backend="ref",
+                    checkpoint=str(ck))
+    a.run(stop_after=stop)
+    haz = [list(h) for h in a.hazards]
+    assert haz, "interrupt point lost its active hazard windows"
+
+    b = LifetimeSim(Scenario.parse(sc.spec()), backend="ref",
+                    checkpoint=str(ck), resume=True)
+    assert b.resumed_from == stop
+    assert [list(h) for h in b.hazards] == haz
+    out = b.run()
+    assert out["digest"] == straight["digest"]
+
+
+def test_fault_kill_in_hazard_decay_then_resume(tmp_path):
+    """The registry-documented kill site: an armed `hazard_decay.<e>`
+    fault dies before that epoch's windows advance, so the checkpoint
+    still holds the pre-decay strengths; the resume replays the decay
+    curve to the straight run's digest."""
+    sc = Scenario.parse(CORR + ",epochs=14,checkpoint_every=1,"
+                        "p_host_outage=0.3,p_rack_outage=0.1")
+    straight = LifetimeSim(Scenario.parse(sc.spec()),
+                           backend="ref").run()
+
+    probe = LifetimeSim(Scenario.parse(sc.spec()), backend="ref")
+    stop = None
+    for e in range(1, sc.epochs - 2):
+        probe.step()
+        if probe.hazards:
+            stop = e
+            break
+    assert stop is not None, "scenario opened no hazard window"
+
+    ck = tmp_path / "ck.json"
+    a = LifetimeSim(Scenario.parse(sc.spec()), backend="ref",
+                    checkpoint=str(ck))
+    a.run(stop_after=stop)  # checkpoints at the interrupt epoch
+    faults.arm("hazard_decay", "fail", "mid-cascade kill", 1)
+    with pytest.raises(faults.FaultInjected):
+        a.step()
+    faults.disarm("hazard_decay")
+
+    b = LifetimeSim(Scenario.parse(sc.spec()), backend="ref",
+                    checkpoint=str(ck), resume=True)
+    assert b.resumed_from == stop
+    assert b.hazards, "checkpoint lost the active hazard windows"
+    out = b.run()
+    assert out["digest"] == straight["digest"]
+
+
+def test_resume_mid_wound_pins_durability_ledger(tmp_path):
+    """Kill while a PG is wounded: the wound counts, heal flags, and
+    exposure totals ride the checkpoint and the resumed digest matches
+    (the |D/|L segments replay bit-identically)."""
+    sc = Scenario.parse(CORR + _QUIET
+                        + ",epochs=12,checkpoint_every=1,"
+                        "max_backfills=1,recovery_mbps=5,osd_mbps=10")
+    straight_sim = LifetimeSim(Scenario.parse(sc.spec()), backend="ref")
+    straight_sim.step(force_event="death")
+    for _ in range(sc.epochs - 1):
+        straight_sim.step()
+    straight = straight_sim.digest
+
+    ck = tmp_path / "ck.json"
+    a = LifetimeSim(Scenario.parse(sc.spec()), backend="ref",
+                    checkpoint=str(ck))
+    a.step(force_event="death")
+    a.step()
+    a._checkpoint()
+    assert any((w > 0).any() for w in a.wounded.values()), \
+        "interrupt point carries no open wound (slow the pipe more)"
+
+    b = LifetimeSim(Scenario.parse(sc.spec()), backend="ref",
+                    checkpoint=str(ck), resume=True)
+    for pid, w in a.wounded.items():
+        assert (b.wounded[pid] == w).all()
+    assert b.exposed_pg_epochs == a.exposed_pg_epochs
+    for _ in range(sc.epochs - 2):
+        b.step()
+    assert b.digest == straight
+
+
+# ----------------------------------------------------------- cli summary
+
+
+def test_cli_prints_chaos_and_durability_triage(capsys):
+    from ceph_tpu.cli import sim as cli_sim
+
+    rc = cli_sim.main(["run", "--scenario", CORR + ",epochs=8",
+                       "--backend", "ref"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "chaos" in out and "cascade(s)" in out
+    assert "durability" in out and "pg_lost" in out

@@ -461,6 +461,43 @@ def test_health_check_flags_untested_declared_code():
     assert v.path == "ceph_tpu/obs/health.py"
 
 
+# -- scenario-event ---------------------------------------------------------
+
+def test_scenario_event_fires_on_undeclared_drawn_kind(tmp_path):
+    """Direction (a): an event_probs() tuple whose kind is missing from
+    EVENT_KINDS fires; declared kinds the fixture never draws surface
+    as dead vocabulary."""
+    d = tmp_path / "sim"
+    d.mkdir()
+    f = d / "lifetime.py"
+    f.write_text(
+        "class Scenario:\n"
+        "    def event_probs(self):\n"
+        "        return ((\"flap\", 0.1), (\"bogus_kind\", 0.2))\n"
+    )
+    ctx = Context(paths=[], include_tests=False)
+    ctx.modules = [Module(f, REPO)]
+    PASSES["scenario-event"].run(ctx)
+    msgs = [v.message for v in ctx.violations]
+    assert any("bogus_kind" in m and "not declared" in m for m in msgs)
+    assert any("'death'" in m and "dead vocabulary" in m for m in msgs)
+
+
+def test_scenario_event_flags_untested_declared_kind():
+    """Direction (b): a declared kind no test literal references is a
+    violation pointing at the EVENT_KINDS registry line — and every
+    *real* kind is covered by the suite."""
+    kind = "zz_" + "never_forced"
+    ctx = Context(paths=[])  # parses tests/, no scanned modules
+    ctx.event_kinds = dict(ctx.event_kinds, **{kind: "never"})
+    ctx.event_lines[kind] = 1
+    PASSES["scenario-event"].run(ctx)
+    assert len(ctx.violations) == 1
+    v = ctx.violations[0]
+    assert kind in v.message and "no test" in v.message
+    assert v.path == "ceph_tpu/sim/lifetime.py"
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_suppression_silences_one_pass(tmp_path):
@@ -518,7 +555,7 @@ def test_fault_registry_covers_compiled_in_points():
     assert set(faults.FAULT_POINTS) == {
         "init", "map_batch", "stage", "stage_end",
         "epoch_apply", "lifetime_step", "recovery_step",
-        "serve_dispatch", "epoch_swap",
+        "hazard_decay", "serve_dispatch", "epoch_swap",
     }
 
 

@@ -95,7 +95,18 @@ from pathlib import Path
 #     summarized `health` status and the serve-timeline sample count.
 #     Everything raw except burn_minutes (wall-clock) — the scenarios
 #     are seeded, so a check that stops firing is semantic drift.
-SCHEMA_VERSION = 9
+# v10: correlated-failure chaos engine (sim/lifetime.py correlated
+#     model).  The lifetime stage grows `chaos` (cascades, repeat
+#     flaps, false-flap revives — seeded counts whose collapse to 0
+#     means the correlation model went inert), `durability` (pg_lost
+#     and exposed PG-epochs: the default scenario is sized SURVIVABLE,
+#     so pg_lost moving 0 -> N is the structural zero-baseline
+#     regression this schema exists to flag), the `overwhelmed`
+#     mini-run record (pg_lost > 0 and the DATA_LOSS latch prove the
+#     loss path can fire) and the `ref_digest_match` backend-exactness
+#     bit.  All raw: every one is bit-determined by the seeded
+#     scenario.
+SCHEMA_VERSION = 10
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -422,6 +433,32 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
     if isinstance(lf.get("health_pure"), bool):
         out["lifetime.health_pure"] = (
             float(lf["health_pure"]), True, False)
+    # correlated-failure chaos + durability ledger (v10): every count
+    # is bit-determined by the seeded scenario.  pg_lost is the
+    # headline — the default scenario is sized survivable, so a 0 -> N
+    # move rides the structural zero-baseline rule and flags
+    # unconditionally; cascades/revives collapsing to 0 means the
+    # correlation model went inert (higher-is-better wiring).
+    cha = lf.get("chaos") or {}
+    put("lifetime.chaos.cascades", cha.get("cascades"), True, False)
+    put("lifetime.chaos.repeat_flaps", cha.get("repeat_flaps"),
+        True, False)
+    put("lifetime.chaos.false_flap_revives",
+        cha.get("false_flap_revives"), True, False)
+    dur = lf.get("durability") or {}
+    put("lifetime.durability.pg_lost", dur.get("pg_lost"),
+        False, False)
+    put("lifetime.durability.exposed_pg_epochs",
+        dur.get("exposed_pg_epochs"), False, False)
+    ovw = lf.get("overwhelmed") or {}
+    put("lifetime.overwhelmed.pg_lost", ovw.get("pg_lost"),
+        True, False)  # the loss path must KEEP firing here
+    if isinstance(ovw.get("data_loss_latched"), bool):
+        out["lifetime.overwhelmed.data_loss_latched"] = (
+            float(ovw["data_loss_latched"]), True, False)
+    if isinstance(lf.get("ref_digest_match"), bool):
+        out["lifetime.ref_digest_match"] = (
+            float(lf["ref_digest_match"]), True, False)
     wl = lf.get("workload") or {}
     put("lifetime.workload.served_qps", wl.get("served_qps"),
         True, True)

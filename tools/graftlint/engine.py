@@ -38,6 +38,7 @@ SPAN_REGISTRY = "ceph_tpu/obs/spans.py"
 KNOB_REGISTRY = "ceph_tpu/utils/knobs.py"
 FAULT_REGISTRY = "ceph_tpu/runtime/faults.py"
 HEALTH_REGISTRY = "ceph_tpu/obs/health.py"
+EVENT_REGISTRY = "ceph_tpu/sim/lifetime.py"
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,-]+)")
 
@@ -231,6 +232,8 @@ class Context:
             self.root / FAULT_REGISTRY, "FAULT_POINTS", {})
         self.health_checks, self.health_lines = _load_registry(
             self.root / HEALTH_REGISTRY, "HEALTH_CHECKS", {})
+        self.event_kinds, self.event_lines = _load_registry(
+            self.root / EVENT_REGISTRY, "EVENT_KINDS", {})
 
     @property
     def test_modules(self) -> list[Module]:

@@ -13,6 +13,7 @@ from tools.graftlint.passes import (  # noqa: F401
     health_check,
     host_sync,
     no_print,
+    scenario_event,
     span_name,
     trace_constant,
 )

@@ -1,0 +1,97 @@
+"""scenario-event: lifetime event kinds are declared, and every one
+is exercised.
+
+`sim/lifetime.py` declares the chaos-event vocabulary (`EVENT_KINDS`)
+and draws epochs from `Scenario.event_probs()` — a FIXED-order
+(kind, probability) walk whose order is part of the replay-digest
+contract.  Two directions, same shape as health-check:
+
+- the kinds `event_probs()` returns must match `EVENT_KINDS` exactly,
+  both ways — a kind drawn but undeclared has no documented digest
+  line; a kind declared but never drawn is dead vocabulary that the
+  docs and the force_event API still advertise;
+- every declared kind must appear as a string literal in at least one
+  test (a `force_event=` call or a bare "<kind>" constant) — an event
+  the suite never forces is a code path no digest has ever pinned.
+
+Both directions are static: the pass reads `event_probs()`'s return
+tuple out of the AST, never importing the simulator.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    EVENT_REGISTRY, Context, Module, Pass, Violation, register,
+)
+
+
+def _declared_probs(module: Module):
+    """Yield (kind, node) for the first-element string literals of the
+    tuples `Scenario.event_probs()` returns."""
+    if module.tree is None:
+        return
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "event_probs"):
+            continue
+        for ret in ast.walk(node):
+            if not isinstance(ret, ast.Return) or ret.value is None:
+                continue
+            for tup in ast.walk(ret.value):
+                if (isinstance(tup, ast.Tuple) and tup.elts
+                        and isinstance(tup.elts[0], ast.Constant)
+                        and isinstance(tup.elts[0].value, str)):
+                    yield tup.elts[0].value, tup.elts[0]
+
+
+@register
+class ScenarioEventPass(Pass):
+    name = "scenario-event"
+    doc = "event_probs() kinds match EVENT_KINDS; each forced by a test"
+
+    def run(self, ctx: Context) -> None:
+        if not ctx.event_kinds:
+            return
+        sim = next((m for m in ctx.modules
+                    if m.rel.endswith("sim/lifetime.py")), None)
+        drawn: dict[str, int] = {}
+        if sim is not None:
+            for kind, node in _declared_probs(sim):
+                drawn.setdefault(kind, node.lineno)
+            for kind, line in sorted(drawn.items()):
+                if kind not in ctx.event_kinds:
+                    ctx.violations.append(Violation(
+                        sim.rel, line, self.name,
+                        f"event_probs() draws kind {kind!r} that is not "
+                        "declared in EVENT_KINDS",
+                    ))
+            for kind in sorted(ctx.event_kinds):
+                if drawn and kind not in drawn:
+                    ctx.violations.append(Violation(
+                        EVENT_REGISTRY, ctx.event_lines.get(kind, 1),
+                        self.name,
+                        f"declared event kind {kind!r} is never drawn by "
+                        "event_probs() — dead vocabulary",
+                    ))
+
+        # every declared kind appears in at least one test literal
+        if not ctx.test_modules:
+            return
+        referenced: set[str] = set()
+        for tm in ctx.test_modules:
+            if tm.tree is None:
+                continue
+            for node in ast.walk(tm.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str) and node.value in ctx.event_kinds:
+                    referenced.add(node.value)
+        for kind in sorted(ctx.event_kinds):
+            if kind not in referenced:
+                ctx.violations.append(Violation(
+                    EVENT_REGISTRY, ctx.event_lines.get(kind, 1),
+                    self.name,
+                    f"declared event kind {kind!r} is exercised by no "
+                    "test — a chaos path no digest has ever pinned",
+                ))
