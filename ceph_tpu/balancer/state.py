@@ -260,6 +260,75 @@ class DeviceState:
         }
 
 
+class FlatDeviceState:
+    """All selected pools' membership rows concatenated into ONE
+    [N, Wmax] i32 tensor — the operand layout of the device-resident
+    optimizer loop (`upmap_state_backend="device_loop"`).
+
+    Built FROM a DeviceState (so row provenance — ClusterState
+    rows_source, per-pool mapper cache, overlay fixups — is exactly the
+    "device" backend's), then flattened: narrower pools pad their slot
+    axis with ITEM_NONE, the global PG axis optionally pads to a
+    multiple of the mesh device count and lands with a
+    NamedSharding(P(axis, None)) placement, so the while_loop kernel's
+    elementwise/scatter work partitions over the PG axis exactly like
+    the PR 15 pipeline.  Host keeps only O(pools) metadata (offsets,
+    pool ids) for the one readback at the end of a plan."""
+
+    def __init__(self, st: DeviceState, mesh=None):
+        jnp = st.jnp
+        self.st = st
+        self.mesh = mesh
+        self.pools: list[int] = sorted(st.rows)
+        self.W = max(
+            (int(st.rows[p].shape[1]) for p in self.pools), default=1
+        )
+        parts, pidx, offs = [], [], [0]
+        for i, pid in enumerate(self.pools):
+            rows = st.rows[pid]
+            n = st.pg_num[pid]
+            rows = rows[:n]  # trim any per-pool mesh pad
+            if int(rows.shape[1]) < self.W:
+                rows = jnp.concatenate([
+                    rows,
+                    jnp.full((int(rows.shape[0]), self.W - int(
+                        rows.shape[1])), ITEM_NONE, rows.dtype),
+                ], axis=1)
+            parts.append(rows)
+            pidx.append(np.full(n, i, np.int32))
+            offs.append(offs[-1] + n)
+        self.n_total = int(offs[-1])
+        rows = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        pool_idx = (pidx[0] if len(pidx) == 1 else np.concatenate(pidx)) \
+            if pidx else np.zeros(0, np.int32)
+        if mesh is not None:
+            d = int(mesh.devices.size)
+            npad = -(-max(self.n_total, 1) // d) * d
+            if npad > self.n_total:
+                rows = jnp.concatenate([
+                    rows,
+                    jnp.full((npad - self.n_total, self.W), ITEM_NONE,
+                             rows.dtype),
+                ])
+                pool_idx = np.concatenate([
+                    pool_idx,
+                    np.full(npad - self.n_total, -1, np.int32),
+                ])
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            rows = st.jax.device_put(
+                rows, NamedSharding(mesh, P(axis, None)))
+        self.rows = rows
+        self.pool_idx = pool_idx  # host i32[Np]; -1 = mesh padding
+        self.offsets = np.asarray(offs, np.int64)
+
+    def locate(self, gidx: int) -> tuple[int, int]:
+        """global PG index -> (pool_id, seed) for the readback."""
+        pos = int(np.searchsorted(self.offsets, gidx, side="right")) - 1
+        return self.pools[pos], int(gidx - self.offsets[pos])
+
+
 class _DeviceTxn:
     def __init__(self, st: DeviceState):
         self.st = st

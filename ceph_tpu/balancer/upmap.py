@@ -255,6 +255,20 @@ _L.add_u64("candidate_conflicts",
            "scored candidates skipped by the non-conflicting-subset "
            "rule (an accepted candidate already touched one of their "
            "OSDs or PGs)")
+# the fully device-resident optimizer (upmap_state_backend
+# "device_loop"): the whole multi-round greedy — candidate generation
+# from the deviation vector, OSD-disjoint selection, the convergence
+# loop with the float-tie guard — runs inside ONE lax.while_loop
+# kernel, so plan_dispatches / changes_accepted is the
+# dispatches-per-accepted-change ratio (1/plan vs 1/batch vs 1/change)
+_L.add_u64("plan_dispatches",
+           "whole-plan device-loop kernel dispatches (one per "
+           "calc_pg_upmaps call on the device_loop backend — every "
+           "round of the plan rides the same dispatch)")
+_L.add_u64("plan_readback_reverts",
+           "device-accepted moves rolled back at readback because the "
+           "exact host pg_upmap_items overlay application could not "
+           "reproduce the device row (booked as changes_rejected too)")
 
 
 @dataclass
@@ -264,6 +278,10 @@ class UpmapResult:
     old_pg_upmap_items: set = field(default_factory=set)
     stddev: float = 0.0
     max_deviation: float = 0.0
+    # device_loop only: the applied moves as (pg, frm, to, round) —
+    # the readback's audit trail, letting tests replay the plan and
+    # check the OSD-disjoint/individually-improving invariants
+    moves: list = field(default_factory=list)
 
 
 def _build_pgs_by_osd(
@@ -607,6 +625,9 @@ def _run_batched(m, st, res, osd_deviation, stddev,
                 break
             deltas = _score_candidates(
                 st, cands, dv, target, inw, use_device_scoring)
+            # candidates the scorer actually turned down — conflict
+            # skips book candidate_conflicts, not changes_rejected
+            _L.inc("changes_rejected", int(np.sum(deltas >= 0.0)))
             # best non-conflicting subset: ascending delta, skip any
             # candidate touching an OSD an accepted one already moved
             # ("no OSD touched twice") — disjointness makes the deltas
@@ -630,7 +651,6 @@ def _run_batched(m, st, res, osd_deviation, stddev,
                 touched |= osds
                 accepted.append(c)
             if not accepted:
-                _L.inc("changes_rejected", len(cands))
                 break
             stddev_before = stddev
             st.commit(txn)
@@ -658,6 +678,367 @@ def _run_batched(m, st, res, osd_deviation, stddev,
     return res
 
 
+# -- fully device-resident optimizer ----------------------------------------
+# backend="device_loop": the ENTIRE plan — per-round candidate
+# generation from the device-resident deviation vector, OSD-disjoint
+# subset selection, and the multi-round convergence loop with the
+# float-tie guard and max_deviation early-exit — runs inside one
+# lax.while_loop, so a whole upmap plan is ONE XLA dispatch whose
+# bounded-shape changes buffer is read back once at the end.  Host work
+# is O(changes): translate each (pg, frm, to) move back into
+# pg_upmap_items pairs and VERIFY each pair list reproduces the device
+# row through the exact production overlay application
+# (OSDMap._apply_upmap) before committing it.
+#
+# Candidate semantics mirror _classify_deviations/_gen_candidates:
+# strict overfull set with the more_overfull takeover when only
+# underfull remain; at most one candidate per overfull OSD (its
+# "dominant" PG — the PG whose worst overfull member it is, lowest
+# global index, an exact-int scatter-min so the choice is identical
+# under any mesh partitioning); targets drawn most-underfull-first from
+# the rule's weight map, excluding the row's own members and any OSD
+# whose failure domain collides with another member's (the
+# try_remap_rule constraint), each target consumed across the round's
+# batch.  Accepted moves must strictly improve the separable
+# sum-of-squares objective (delta = 2*(dev_to - dev_frm) + 2 < 0) and
+# touch no OSD twice, so deltas stay additive — the _run_batched
+# invariant — and every accept is an independent improvement.
+#
+# NOT on device: the sequential loop's underfull fallback pass (drop
+# remaps OUT of strongly-underfull OSDs) — it needs the pg_upmap_items
+# dict, which stays host-side.  Irrelevant for fresh-map rebalance (no
+# items to drop); converged maps that only need drops fall back to the
+# host backends.
+
+_LOOP_ACCTS: dict = {}
+
+_DOM_NONE = np.int32(0x7FFFFFFF)  # dom_tbl sentinel: not in rule
+
+
+def _loop_account(npg, w, dv, npool, nbatch, ncap, mesh_size):
+    """The jitted whole-plan kernel, one executable per
+    (PGs, slot width, OSD bound, pools, candidate batch, change cap,
+    mesh) shape — registered like every trace-once kernel.
+    max_deviation and the change/round budget are traced scalars, so
+    re-planning with a different budget does not retrace."""
+    key = (npg, w, dv, npool, nbatch, ncap, mesh_size)
+    acct = _LOOP_ACCTS.get(key)
+    if acct is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def _plan(rows, pidx, movable, dom_tbl, tgt_ok, target, inw,
+                  counts, max_dev, budget):
+            inwm = inw > 0.0
+            gidx = jnp.arange(npg, dtype=jnp.int32)
+            warange = jnp.arange(w, dtype=jnp.int32)
+            barange = jnp.arange(nbatch, dtype=jnp.int32)
+            psafe = jnp.clip(pidx, 0, npool - 1)
+
+            def dev_of(c):
+                return jnp.where(
+                    inwm, c.astype(jnp.float64) - target, 0.0)
+
+            def round_body(carry):
+                (rows, counts, cpg, cfrm, cto, crnd, n_chg, n_rej,
+                 rounds, sum_sq, _) = carry
+                dev = dev_of(counts)
+                has_over = jnp.any(dev > max_dev)
+                has_under = jnp.any(dev < -max_dev)
+                # more_overfull takeover when only underfull remain
+                over = jnp.where(has_over, dev > max_dev,
+                                 (dev > 0.0) & has_under) & inwm
+                # candidate PG per overfull OSD: the lowest-index PG
+                # whose WORST overfull member it is (exact-int
+                # scatter-min — identical under any sharding)
+                valid_m = (rows >= 0) & (rows < dv)
+                rsafe = jnp.where(valid_m, rows, 0)
+                rdev = jnp.where(valid_m & over[rsafe],
+                                 dev.astype(jnp.float32)[rsafe],
+                                 -jnp.inf)
+                dmax = jnp.max(rdev, axis=1)
+                darg = jnp.argmax(rdev, axis=1).astype(jnp.int32)
+                dosd = jnp.where(
+                    jnp.isfinite(dmax) & movable,
+                    jnp.take_along_axis(rsafe, darg[:, None], 1)[:, 0],
+                    dv)
+                pick = jnp.full((dv,), npg, jnp.int32).at[dosd].min(
+                    gidx, mode="drop")
+                # top-B overfull OSDs by deviation
+                topv, topi = lax.top_k(jnp.where(over, dev, -jnp.inf),
+                                       nbatch)
+
+                def cand(k, acc):
+                    used, apg, aslot, ato, afrm, n_acc, rej = acc
+                    frm = topi[k].astype(jnp.int32)
+                    pg = pick[frm]
+                    valid = jnp.isfinite(topv[k]) & ~used[frm] \
+                        & (pg < npg)
+                    pgc = jnp.clip(pg, 0, npg - 1)
+                    row = rows[pgc]
+                    vm = (row >= 0) & (row < dv)
+                    rsc = jnp.where(vm, row, 0)
+                    smask = vm & (row == frm)
+                    valid &= jnp.any(smask)
+                    slot = jnp.argmax(smask).astype(jnp.int32)
+                    p = psafe[pgc]
+                    dtbl = dom_tbl[p]
+                    in_row = jnp.zeros((dv,), bool).at[
+                        jnp.where(vm, rsc, dv)].set(True, mode="drop")
+                    # failure-domain constraint: the replacement may
+                    # not land in any OTHER member's domain
+                    mdom = jnp.where(vm & (warange != slot),
+                                     dtbl[rsc], _DOM_NONE)
+                    dom_ok = jnp.all(
+                        dtbl[:, None] != mdom[None, :], axis=1)
+                    allowed = inwm & (dev < 0.0) & tgt_ok[p] & ~used \
+                        & ~in_row & dom_ok
+                    has_t = jnp.any(allowed)
+                    t = jnp.argmin(
+                        jnp.where(allowed, dev, jnp.inf)
+                    ).astype(jnp.int32)
+                    # separable objective: moving one PG frm->to
+                    delta = 2.0 * (dev[t] - dev[frm]) + 2.0
+                    cand_ok = valid & has_t
+                    accept = cand_ok & (delta < 0.0) \
+                        & (n_chg + n_acc < budget)
+                    rej = rej + jnp.where(
+                        cand_ok & (delta >= 0.0), 1, 0
+                    ).astype(jnp.int32)
+                    ins = jnp.where(accept, n_acc, nbatch)
+                    apg = apg.at[ins].set(pg, mode="drop")
+                    aslot = aslot.at[ins].set(slot, mode="drop")
+                    ato = ato.at[ins].set(t, mode="drop")
+                    afrm = afrm.at[ins].set(frm, mode="drop")
+                    # targets consume across the batch whether or not
+                    # the score accepts (mirrors _gen_candidates'
+                    # used_targets)
+                    used = used.at[jnp.where(cand_ok, t, dv)].set(
+                        True, mode="drop")
+                    used = used.at[jnp.where(accept, frm, dv)].set(
+                        True, mode="drop")
+                    return (used, apg, aslot, ato, afrm,
+                            n_acc + accept.astype(jnp.int32), rej)
+
+                used, apg, aslot, ato, afrm, n_acc, rej_r = \
+                    lax.fori_loop(
+                        0, nbatch, cand,
+                        (jnp.zeros((dv,), bool),
+                         jnp.full((nbatch,), npg, jnp.int32),
+                         jnp.zeros((nbatch,), jnp.int32),
+                         jnp.full((nbatch,), dv, jnp.int32),
+                         jnp.full((nbatch,), dv, jnp.int32),
+                         jnp.int32(0), jnp.int32(0)))
+                # apply: per-round PGs are distinct (one dominant
+                # member each) and OSDs disjoint, so scatters commute
+                rows2 = rows.at[apg, aslot].set(ato, mode="drop")
+                counts2 = counts.at[afrm].add(-1, mode="drop")
+                counts2 = counts2.at[ato].add(1, mode="drop")
+                bpos = jnp.where(barange < n_acc,
+                                 n_chg + barange, ncap)
+                cpg2 = cpg.at[bpos].set(apg, mode="drop")
+                cfrm2 = cfrm.at[bpos].set(afrm, mode="drop")
+                cto2 = cto.at[bpos].set(ato, mode="drop")
+                crnd2 = crnd.at[bpos].set(rounds + 1, mode="drop")
+                n_chg2 = n_chg + n_acc
+                devn = dev_of(counts2)
+                ss2 = jnp.sum(devn * devn)
+                mx2 = jnp.max(jnp.abs(devn))
+                rounds2 = rounds + 1
+                # the sequential loop's exits: nothing accepted, the
+                # float-tie guard (never loop on a non-improvement),
+                # max_deviation reached, round/change budget spent
+                cont = (n_acc > 0) & (ss2 < sum_sq) \
+                    & (mx2 > max_dev) & (rounds2 < budget) \
+                    & (n_chg2 < budget)
+                return (rows2, counts2, cpg2, cfrm2, cto2, crnd2,
+                        n_chg2, n_rej + rej_r, rounds2, ss2, cont)
+
+            dev0 = dev_of(counts)
+            init = (rows, counts,
+                    jnp.full((ncap,), npg, jnp.int32),
+                    jnp.full((ncap,), dv, jnp.int32),
+                    jnp.full((ncap,), dv, jnp.int32),
+                    jnp.zeros((ncap,), jnp.int32),
+                    jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                    jnp.sum(dev0 * dev0), jnp.bool_(True))
+            (rows_f, counts_f, cpg, cfrm, cto, crnd, n_chg, n_rej,
+             rounds, ss_f, _) = lax.while_loop(
+                lambda c: c[-1], round_body, init)
+            # gather the final rows of every changed PG INSIDE the
+            # dispatch: readback is then a pure fetch of bounded-shape
+            # outputs — no second kernel
+            crows = rows_f[jnp.clip(cpg, 0, npg - 1)]
+            dev_f = dev_of(counts_f)
+            return (cpg, cfrm, cto, crnd, crows, n_chg, n_rej, rounds,
+                    counts_f, ss_f, jnp.max(jnp.abs(dev_f)))
+
+        jfn = jax.jit(_plan)
+        rec = obs.executables.register(
+            "balancer", "device_loop", ("device_loop",) + key, fn=jfn)
+        acct = _LOOP_ACCTS[key] = obs.JitAccount(
+            jfn, _L, "device_loop", exec_record=rec)
+    return acct
+
+
+def _run_device_loop(m, fst, res, max_deviation, max_iter,
+                     candidate_batch):
+    """Host driver for the device_loop backend: build the O(OSDs)
+    metadata (targets/domain tables), launch the one-dispatch plan
+    kernel, then translate the changes buffer back into
+    pg_upmap_items — verifying every pair list against the exact
+    production overlay application before committing it."""
+    import jax.numpy as jnp
+
+    st = fst.st
+    dv = max(int(m.max_osd), 1)
+    target = np.zeros(dv, np.float64)
+    inw = np.zeros(dv, np.float64)
+    for osd, w2 in st.osd_weight.items():
+        if 0 <= osd < dv:
+            target[osd] = w2 * st.ppw
+            inw[osd] = 1.0
+    # per-pool valid-target mask and failure-domain table (the
+    # try_remap_rule subtree/domain constraints, precomputed once)
+    P = max(len(fst.pools), 1)
+    dom_tbl = np.full((P, dv), _DOM_NONE, np.int32)
+    tgt_ok = np.zeros((P, dv), bool)
+    for i, pid in enumerate(fst.pools):
+        pool = m.pools[pid]
+        ruleno = mapper_ref.find_rule(
+            m.crush, pool.crush_rule, int(pool.type), pool.size)
+        if ruleno < 0:
+            continue
+        dom_type = 0
+        for op, _a1, a2 in m.crush.rules[ruleno].steps:
+            if op in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSE_INDEP,
+                      RuleOp.CHOOSELEAF_FIRSTN,
+                      RuleOp.CHOOSELEAF_INDEP) and a2 > 0:
+                dom_type = a2
+                break
+        for osd in get_rule_weight_osd_map(m.crush, ruleno):
+            if not (0 <= osd < dv):
+                continue
+            # a down OSD reads maximally underfull (its count is 0 —
+            # _raw_to_up_osds filters it everywhere) but can never be
+            # a target: the committed pair would be skipped/filtered
+            # by the exact overlay application and revert at readback
+            tgt_ok[i, osd] = m.exists(osd) and not m.is_down(osd)
+            dom_tbl[i, osd] = (
+                get_parent_of_type(m.crush, osd, dom_type, ruleno)
+                if dom_type > 0 else osd)
+    movable = np.ones(fst.pool_idx.shape[0], bool)
+    movable[fst.pool_idx < 0] = False  # mesh padding
+    pool_pos = {pid: i for i, pid in enumerate(fst.pools)}
+    for pg in m.pg_upmap:  # full-remap PGs are frozen
+        i = pool_pos.get(pg.pool)
+        if i is not None and pg.ps < m.pools[pg.pool].pg_num:
+            movable[int(fst.offsets[i]) + pg.ps] = False
+
+    B = max(1, min(int(candidate_batch), dv))
+    C = -(-max(int(max_iter), 1) // 8) * 8  # change cap, cycle-padded
+    npg = int(fst.rows.shape[0])
+    W = int(fst.rows.shape[1])
+    mesh_size = int(fst.mesh.devices.size) if fst.mesh is not None \
+        else 0
+    acct = _loop_account(npg, W, dv, P, B, C, mesh_size)
+    _L.inc("plan_dispatches")
+    with obs.span("balancer.device_loop", pgs=npg, osds=dv, batch=B,
+                  budget=int(max_iter), mesh=mesh_size):
+        out = acct(
+            fst.rows, jnp.asarray(fst.pool_idx), jnp.asarray(movable),
+            jnp.asarray(dom_tbl), jnp.asarray(tgt_ok),
+            jnp.asarray(target), jnp.asarray(inw),
+            jnp.asarray(st.counts.astype(np.int64)),
+            jnp.float64(float(max_deviation)),
+            jnp.int32(int(max_iter)))
+    (cpg_d, cfrm_d, cto_d, crnd_d, crows_d, n_chg, n_rej, rounds_d,
+     counts_f, _ss_f, _mx_f) = out
+    n_chg, n_rej, rounds_d = int(n_chg), int(n_rej), int(rounds_d)
+    _L.inc("rounds", rounds_d)
+    _L.inc("changes_rejected", n_rej)
+    cpg = np.asarray(cpg_d)[:n_chg]
+    cfrm = np.asarray(cfrm_d)[:n_chg]
+    cto = np.asarray(cto_d)[:n_chg]
+    crnd = np.asarray(crnd_d)[:n_chg]
+    crows = np.asarray(crows_d)[:n_chg]
+    counts_np = np.asarray(counts_f).copy()
+
+    # readback: compose each changed PG's recorded moves (in round
+    # order) onto its existing pg_upmap_items — a move whose `frm` is
+    # an earlier pair's target rewrites that pair (or cancels it when
+    # it lands back on the raw member) — then VERIFY the pair list
+    # reproduces the device row through the exact production transform
+    # (_pg_to_raw_osds -> _apply_upmap -> _raw_to_up_osds) before
+    # committing it
+    last: dict[int, int] = {}
+    moves_of: dict[int, list[int]] = {}
+    for i in range(n_chg):
+        g = int(cpg[i])
+        last[g] = i
+        moves_of.setdefault(g, []).append(i)
+    W = int(crows.shape[1]) if n_chg else 0
+    applied = 0
+    for g in sorted(last):
+        pid, seed = fst.locate(g)
+        pool = m.pools[pid]
+        pg = PgId(pid, seed)
+        old = m.pg_upmap_items.get(pg)
+        pairs = list(old or [])
+        for j in moves_of[g]:
+            frm, to = int(cfrm[j]), int(cto[j])
+            for k2, (a, b) in enumerate(pairs):
+                if b == frm:
+                    if a == to:
+                        del pairs[k2]  # back to the raw member
+                    else:
+                        pairs[k2] = (a, to)
+                    break
+            else:
+                pairs.append((frm, to))
+        raw, _ = m._pg_to_raw_osds(pool, pg)
+        if pairs:
+            m.pg_upmap_items[pg] = pairs
+        elif pg in m.pg_upmap_items:
+            del m.pg_upmap_items[pg]
+        chk = list(raw)
+        m._apply_upmap(pool, pg, chk)
+        chk = m._raw_to_up_osds(pool, chk)
+        want = chk + [ITEM_NONE] * (W - len(chk))
+        if [int(x) for x in crows[last[g]]] != want[:W]:
+            # the exact overlay application cannot express this row
+            # (pair-order/skip interaction with pre-existing items):
+            # revert, roll the counts back, book the moves rejected
+            if old is not None:
+                m.pg_upmap_items[pg] = old
+            elif pg in m.pg_upmap_items:
+                del m.pg_upmap_items[pg]
+            for j in moves_of[g]:
+                counts_np[int(cfrm[j])] += 1
+                counts_np[int(cto[j])] -= 1
+            _L.inc("plan_readback_reverts", len(moves_of[g]))
+            _L.inc("changes_rejected", len(moves_of[g]))
+            continue
+        applied += len(moves_of[g])
+        res.num_changed += len(moves_of[g])
+        for j in moves_of[g]:
+            res.moves.append((pg, int(cfrm[j]), int(cto[j]),
+                              int(crnd[j])))
+        if pairs:
+            res.new_pg_upmap_items[pg] = list(pairs)
+        elif old is not None:
+            res.old_pg_upmap_items.add(pg)
+    _L.inc("changes_accepted", applied)
+    _, stddev, cur_max = st._dev_from_counts(counts_np)
+    _L.observe("stddev", stddev)
+    _L.observe("max_deviation", cur_max)
+    obs.counter("balancer.stddev", stddev)
+    res.stddev = stddev
+    res.max_deviation = cur_max
+    return res
+
+
 def calc_pg_upmaps(
     m: OSDMap,
     max_deviation: int = 5,
@@ -676,11 +1057,14 @@ def calc_pg_upmaps(
     """Greedy upmap optimization; mutates m.pg_upmap_items.  Returns the
     change set (the reference's pending_inc).  reference OSDMap.cc:4634.
 
-    backend: "sets" (reference-faithful dict-of-sets, small maps) or
+    backend: "sets" (reference-faithful dict-of-sets, small maps),
     "device" (membership rows on device, O(OSDs) host state — the
     10M-PG/10k-OSD form; sharded over `mesh`, defaulting to the
-    CEPH_TPU_MESH_DEVICES mesh).  Both evolve the same bookkeeping;
-    equivalence is pinned by tests/test_balancer.py.
+    CEPH_TPU_MESH_DEVICES mesh), or "device_loop" (the whole
+    multi-round greedy inside one lax.while_loop — a full plan in ONE
+    XLA dispatch, changes read back once; sharded over `mesh` the same
+    way).  All evolve the same bookkeeping; equivalence is pinned by
+    tests/test_balancer.py and tests/test_multichip.py.
 
     candidate_batch: 0 = the reference-faithful sequential greedy (one
     evaluated change per round-trip); N>0 = the candidate-batched
@@ -691,7 +1075,7 @@ def calc_pg_upmaps(
     """
     from ceph_tpu.balancer.state import DeviceState, SetState
 
-    if backend == "device" and mesh is None:
+    if backend in ("device", "device_loop") and mesh is None:
         from ceph_tpu.parallel.sharded import default_mesh
 
         mesh = default_mesh()
@@ -745,10 +1129,13 @@ def calc_pg_upmaps(
         "balancer.build_state", backend=backend, pgs=total_pgs,
         reused=rows_source is not None,
     ):
-        if backend == "device":
+        if backend in ("device", "device_loop"):
+            # device_loop re-pads/shards the CONCATENATED pg axis
+            # itself, so its per-pool DeviceState rows stay unsharded
             st = DeviceState(
                 m, osd_weight, pgs_per_weight, only_pools=only_pools,
-                mesh=mesh, cache=device_cache, rows_source=src,
+                mesh=mesh if backend == "device" else None,
+                cache=device_cache, rows_source=src,
             )
         else:
             pgs_by_osd = _build_pgs_by_osd(m, only_pools, use_tpu,
@@ -763,6 +1150,14 @@ def calc_pg_upmaps(
     res.stddev, res.max_deviation = stddev, cur_max_deviation
     if cur_max_deviation <= max_deviation:
         return res
+
+    if backend == "device_loop":
+        from ceph_tpu.balancer.state import FlatDeviceState
+
+        fst = FlatDeviceState(st, mesh)
+        return _run_device_loop(
+            m, fst, res, max_deviation, max_iter,
+            int(candidate_batch) or 16)
 
     if candidate_batch:
         return _run_batched(

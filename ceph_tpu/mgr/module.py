@@ -60,11 +60,15 @@ DEFAULT_OPTIONS: dict = {
     "crush_compat_metrics": "pgs,objects,bytes",
     "min_score": 0.0,
     "target_max_misplaced_ratio": 0.05,
-    "upmap_state_backend": "sets",   # sets | device (balancer.state)
+    # sets | device | device_loop (balancer.state / balancer.upmap):
+    # device_loop runs the WHOLE multi-round greedy inside one
+    # lax.while_loop — a full plan per pool in one XLA dispatch
+    "upmap_state_backend": "sets",
     # 0 = the reference-faithful sequential greedy; N>0 = the
     # candidate-batched optimizer (score N prospective changes per
     # vectorized dispatch, accept the best non-conflicting subset —
-    # see balancer.upmap._run_batched)
+    # see balancer.upmap._run_batched; on device_loop it is the
+    # per-round on-device candidate budget, default 16)
     "upmap_candidate_batch": 0,
 }
 

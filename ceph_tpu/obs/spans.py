@@ -50,6 +50,9 @@ SPANS: dict[str, str] = {
     "balancer.score_candidates": "one vectorized deviation-delta "
                                  "evaluation over a batch of "
                                  "prospective upmap changes",
+    "balancer.device_loop": "one whole-plan device-resident optimizer "
+                            "dispatch (every round of the greedy "
+                            "inside one lax.while_loop)",
     # mgr/
     "mgr.map_pool": "eval distribution mapping pass for one pool",
     "mgr.pool_counts": "per-OSD pg/object/byte count reduction",
@@ -105,6 +108,10 @@ SPANS: dict[str, str] = {
                   "reader path; the flip itself is swap_stall_seconds)",
     "serve.chaos": "chaos-client harness: lifetime churn against a "
                    "live service under client load",
+    "serve.background_balance": "one background balancing round: "
+                                "device-loop plan computed off the "
+                                "query path, applied as a value-only "
+                                "overlay swap",
     "bench.serve": "serve bench stage body",
     # cli/
     "daemon.selftest": "daemon CLI miniature workload",

@@ -103,6 +103,25 @@ _L.add_quantile("swap_stall_seconds",
 _L.add_time_avg("swap_prepare_seconds",
                 "off-path staging cost of one epoch swap (clone + "
                 "apply + mapper construction + warm dispatch)")
+# continuous background balancing: a whole-plan device-loop upmap
+# optimization computed BETWEEN epoch swaps (never on the query path)
+# and applied as a value-only overlay epoch
+_L.add_u64("background_rounds",
+           "background balancing rounds (one device-loop plan each, "
+           "computed off the query path)")
+_L.add_u64("background_changes",
+           "upmap changes applied by background balancing rounds "
+           "(value-only overlay epochs)")
+_L.add_u64("background_stale_plans",
+           "background plans discarded unapplied because another "
+           "epoch flipped in while the plan was being computed")
+_L.add_time_avg("background_round_seconds",
+                "wall time of one background balancing round (plan + "
+                "value-only apply)")
+_L.add_quantile("background_round_hist",
+                "background balancing round wall-time distribution "
+                "(p50/p99 — the bound the serve bench gates while "
+                "clients stay live)")
 
 
 @dataclass
@@ -499,6 +518,58 @@ class PlacementService:
             self._checkpoint()
         return {"ok": True, "epoch": buf.epoch,
                 "swap_stall_s": round(stall, 6)}
+
+    def background_balance(self, max_deviation: int = 1,
+                           max_iter: int = 16,
+                           candidate_batch: int = 16) -> dict:
+        """One CONTINUOUS-BALANCING round: compute a whole-plan
+        device-loop upmap optimization against the active epoch's map
+        — off the query path, WITHOUT holding the apply lock, one XLA
+        dispatch for the entire plan — and apply any changes as one
+        value-only overlay epoch (O(delta) staging; readers only ever
+        see the atomic flip).  A plan that raced a concurrent epoch
+        swap is discarded, never applied stale."""
+        from ceph_tpu.balancer.upmap import calc_pg_upmaps
+        from ceph_tpu.osd.state import value_copy_map
+
+        t0 = time.perf_counter()
+        buf = self._active  # snapshot; planning never blocks appliers
+        applied: dict = {"ok": True, "epoch": buf.epoch}
+        with obs.span("serve.background_balance", epoch=buf.epoch), \
+                _L.time("background_round_hist"):
+            m2 = value_copy_map(buf.m)
+            src = buf.state.rows_source_for(m2) \
+                if buf.state is not None else None
+            res = calc_pg_upmaps(
+                m2, max_deviation=max_deviation, max_iter=max_iter,
+                backend="device_loop", candidate_batch=candidate_batch,
+                rows_source=src)
+            if res.num_changed:
+                if self._active is buf:
+                    inc = Incremental(epoch=buf.epoch + 1)
+                    inc.new_pg_upmap_items = {
+                        pg: list(v)
+                        for pg, v in res.new_pg_upmap_items.items()}
+                    inc.old_pg_upmap_items = set(
+                        res.old_pg_upmap_items)
+                    applied = self.apply(inc)
+                else:
+                    _L.inc("background_stale_plans")
+                    applied = {"ok": False,
+                               "epoch": self._active.epoch,
+                               "error": "stale plan (epoch moved "
+                                        "during planning)"}
+        _L.inc("background_rounds")
+        if applied.get("ok"):
+            _L.inc("background_changes", res.num_changed)
+        dt = time.perf_counter() - t0
+        _L.observe("background_round_seconds", dt)
+        return {"ok": bool(applied.get("ok", False)),
+                "epoch": int(applied.get("epoch", buf.epoch)),
+                "num_changed": res.num_changed,
+                "stddev": res.stddev,
+                "max_deviation": res.max_deviation,
+                "round_s": round(dt, 6)}
 
     def _checkpoint(self) -> None:
         if self.ck is None:

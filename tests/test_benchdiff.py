@@ -127,6 +127,13 @@ def test_seeded_regressions_flagged():
         # and the exposure blow-up are semantic drift, compared raw
         "lifetime.durability.pg_lost",         # 0 -> 3: DATA LOSS
         "lifetime.durability.exposed_pg_epochs",  # 61 -> 188
+        # device-loop optimizer (v11, seeded in r19->r20): the
+        # one-dispatch plan fell apart into per-round launches and the
+        # live background window compiled — dispatch/compile counts
+        # are bit-determined by the seeded run, compared raw
+        "rebalance.plan_dispatches",           # 2 -> 20
+        "rebalance.dispatches_per_change",     # 0.1 -> 1.0
+        "serve.background_query_compiles",     # 0 -> 3: zero baseline
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -295,6 +302,44 @@ def test_durability_fixture_pair_v10():
         d["metric"].startswith(("lifetime.chaos.",
                                 "lifetime.durability.",
                                 "lifetime.overwhelmed."))
+        for d in rep2["regressions"])
+
+
+def test_deviceloop_fixture_pair_v11():
+    """The v11 seeded pair in isolation: the healthy device-loop round
+    (r19, one dispatch per plan, 0 compiles in the background window)
+    against the regression (r20: the plan fell apart into per-round
+    dispatches, the round tail blew out, and the live window compiled).
+    Dispatch counts are bit-determined by the seeded run — raw; the
+    round tail is wall-clock — normalized; the window compile count
+    rides the structural zero-baseline rule."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r19"], by["r20"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    for name in ("rebalance.plan_dispatches",
+                 "rebalance.dispatches_per_change"):
+        assert name in flagged, name
+        assert not flagged[name]["normalized"]  # structural: raw
+    assert flagged["rebalance.plan_dispatches"]["prev"] == 2
+    assert flagged["rebalance.plan_dispatches"]["cur"] == 20
+    assert "serve.background_round_p99_ms" in flagged
+    assert flagged["serve.background_round_p99_ms"]["normalized"]
+    d = flagged["serve.background_query_compiles"]
+    assert not d["normalized"]
+    assert d["prev"] == 0 and d["cur"] == 3
+    assert d["change"] is None          # zero baseline: no finite pct
+    # the healthy record alone extracts the full v11 shape
+    m = extract_metrics(by["r19"].record)
+    assert m["rebalance.plan_dispatches"][0] == 2
+    assert m["rebalance.dispatches_per_change"][0] == 0.1
+    assert m["serve.background_round_p99_ms"][0] == 85.0
+    assert m["serve.background_query_compiles"][0] == 0.0
+    # the healthy direction (r18 regression recovering into r19) never
+    # flags a device-loop metric
+    rep2 = diff_series([by["r18"], by["r19"]])
+    assert not any(
+        d["metric"].startswith(("rebalance.", "serve.background"))
         for d in rep2["regressions"])
 
 

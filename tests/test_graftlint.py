@@ -498,6 +498,49 @@ def test_scenario_event_flags_untested_declared_kind():
     assert v.path == "ceph_tpu/sim/lifetime.py"
 
 
+# -- balancer-options -------------------------------------------------------
+
+def test_balancer_options_fires_on_undeclared_key(tmp_path):
+    """Direction (a): a get_option() site consuming an upmap_* key that
+    DEFAULT_OPTIONS never declares fires; declared upmap keys and
+    non-upmap keys stay silent."""
+    v = lint(tmp_path, (
+        "x = self.get_option('upmap_bogus_knob')\n"
+        "y = self.get_option('upmap_max_deviation')\n"
+        "z = self.get_option('mode')\n"
+    ), "balancer-options")
+    assert [x.line for x in v] == [1]
+    assert "upmap_bogus_knob" in v[0].message
+    assert "never be set" in v[0].message
+
+
+def test_balancer_options_flags_undocumented_untested_key(monkeypatch):
+    """Directions (b)+(c): a declared upmap_* key missing from both the
+    README options table and every test literal fires twice — and every
+    *real* key is documented and test-forced (no other violations)."""
+    import tools.graftlint.passes.balancer_options as bo
+
+    # built dynamically: a bare literal here would itself count as the
+    # test forcing the pass is looking for (this file lives in tests/)
+    key = "upmap_zz_" + "phantom"
+    real = bo._load_registry
+
+    def salted(path, name, default):
+        declared, lines = real(path, name, default)
+        if name == "DEFAULT_OPTIONS" and declared:
+            declared = dict(declared, **{key: 0})
+            lines = dict(lines, **{key: 1})
+        return declared, lines
+
+    monkeypatch.setattr(bo, "_load_registry", salted)
+    ctx = Context()  # full scan: README and tests/ in view
+    PASSES["balancer-options"].run(ctx)
+    assert len(ctx.violations) == 2, ctx.violations
+    msgs = [v.message for v in ctx.violations]
+    assert any(key in m and "README" in m for m in msgs)
+    assert any(key in m and "no test" in m for m in msgs)
+
+
 # -- suppressions -----------------------------------------------------------
 
 def test_suppression_silences_one_pass(tmp_path):

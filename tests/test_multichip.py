@@ -227,6 +227,188 @@ class TestCandidateBatched:
             assert s1["candidate_batches"] > s0["candidate_batches"]
 
 
+# -- fully device-resident optimizer (device_loop) --------------------------
+
+def _loop_snap():
+    d = obs.perf_dump().get("balancer") or {}
+    return {k: int(d.get(k, 0)) for k in (
+        "changes_accepted", "changes_rejected", "candidate_batches",
+        "plan_dispatches", "plan_readback_reverts",
+        "device_loop_compiles", "device_loop_cache_hits",
+        "device_loop_retraces")}
+
+
+class TestDeviceLoop:
+    """The whole-plan device-resident optimizer: ONE XLA dispatch per
+    plan, quality no worse than the host backends, every accepted move
+    OSD-disjoint and individually improving."""
+
+    def test_equivalence_gate_one_dispatch(self):
+        # identical fresh maps, same seed, a budget all three backends
+        # can converge within (upmap_state_backend="device_loop"
+        # behind the same calc_pg_upmaps options as "sets"/"device")
+        max_dev = 2
+        m1, m2, m3 = skewed(), skewed(), skewed()
+        s0 = _loop_snap()
+        r1 = calc_pg_upmaps(
+            m1, max_deviation=max_dev, max_iter=200, use_tpu=False,
+            rng=np.random.default_rng(42))
+        s1 = _loop_snap()
+        r2 = calc_pg_upmaps(
+            m2, max_deviation=max_dev, max_iter=200, use_tpu=False,
+            rng=np.random.default_rng(42), candidate_batch=16)
+        s2 = _loop_snap()
+        r3 = calc_pg_upmaps(
+            m3, max_deviation=max_dev, max_iter=200,
+            rng=np.random.default_rng(42), backend="device_loop",
+            candidate_batch=16)
+        s3 = _loop_snap()
+        assert r3.num_changed > 0
+        # ONE plan dispatch for the whole multi-round plan: the
+        # counter, the kernel executions, and zero retraces
+        assert s3["plan_dispatches"] - s2["plan_dispatches"] == 1
+        kernel_execs = (
+            s3["device_loop_compiles"] - s2["device_loop_compiles"]
+            + s3["device_loop_cache_hits"]
+            - s2["device_loop_cache_hits"])
+        assert kernel_execs == 1
+        assert s3["device_loop_retraces"] == s2["device_loop_retraces"]
+        assert s3["plan_readback_reverts"] == s2["plan_readback_reverts"]
+        # dispatches per accepted change strictly below the batched
+        # backend at equal budget (which is itself below sequential)
+        acc2 = s2["changes_accepted"] - s1["changes_accepted"]
+        acc3 = s3["changes_accepted"] - s2["changes_accepted"]
+        batches2 = s2["candidate_batches"] - s1["candidate_batches"]
+        assert acc2 > 0 and acc3 > 0 and batches2 > 1
+        assert 1 / acc3 < batches2 / acc2
+        # final quality no worse than EITHER host backend
+        assert r3.stddev <= min(r1.stddev, r2.stddev) + 1e-9
+        assert r3.max_deviation <= min(r1.max_deviation,
+                                       r2.max_deviation) + 1e-9
+        TestCandidateBatched._assert_valid(m3)
+
+    def test_moves_osd_disjoint_and_individually_improving(self):
+        """Replay the plan's audit trail: within every round no OSD is
+        touched twice (so per-move deltas are additive), and each
+        move's own delta — evaluated against the counts at its round's
+        start — is strictly negative."""
+        m = skewed()
+        r = calc_pg_upmaps(
+            m, max_deviation=2, max_iter=200,
+            rng=np.random.default_rng(42), backend="device_loop",
+            candidate_batch=16)
+        assert r.moves and len(r.moves) == r.num_changed
+        # counts/targets of the identical fresh map
+        from ceph_tpu.balancer.upmap import _build_pgs_by_osd
+        from ceph_tpu.balancer.crush_analysis import (
+            get_rule_weight_osd_map,
+        )
+        from ceph_tpu.crush import mapper_ref
+
+        m0 = skewed()
+        pool = m0.pools[0]
+        ruleno = mapper_ref.find_rule(
+            m0.crush, pool.crush_rule, int(pool.type), pool.size)
+        osd_weight = {
+            o: m0.get_weightf(o) * w for o, w in
+            get_rule_weight_osd_map(m0.crush, ruleno).items()
+            if m0.get_weightf(o) * w > 0}
+        ppw = pool.size * pool.pg_num / sum(osd_weight.values())
+        pbo = _build_pgs_by_osd(m0, set(), use_tpu=False)
+        counts = {o: len(pbo.get(o, ())) for o in osd_weight}
+        rounds: dict[int, list] = {}
+        for pg, frm, to, rnd in r.moves:
+            rounds.setdefault(rnd, []).append((pg, frm, to))
+        for rnd in sorted(rounds):
+            touched: set[int] = set()
+            dev = {o: counts[o] - osd_weight[o] * ppw
+                   for o in osd_weight}
+            for pg, frm, to in rounds[rnd]:
+                assert frm not in touched and to not in touched, \
+                    (rnd, frm, to)
+                touched |= {frm, to}
+                delta = 2 * (dev[to] - dev[frm]) + 2
+                assert delta < 0, (rnd, pg, frm, to, delta)
+            for _, frm, to in rounds[rnd]:
+                counts[frm] -= 1
+                counts[to] += 1
+        # the replayed end state matches the plan's reported quality
+        d = np.asarray([counts[o] - osd_weight[o] * ppw
+                        for o in sorted(osd_weight)])
+        assert abs(float(np.sum(d * d)) - r.stddev) < 1e-6
+        assert abs(float(np.max(np.abs(d))) - r.max_deviation) < 1e-6
+
+    def test_mesh_bit_identical_plan(self):
+        """The plan shards over CEPH_TPU_MESH_DEVICES like the PR 15
+        pipeline: 2 forced devices produce the bit-identical plan (the
+        PG-axis work is elementwise + exact-int scatter-min, so GSPMD
+        partitioning cannot move a decision)."""
+        m1, m2 = skewed(), skewed()
+        r1 = calc_pg_upmaps(
+            m1, max_deviation=2, max_iter=48,
+            rng=np.random.default_rng(5), backend="device_loop")
+        r2 = calc_pg_upmaps(
+            m2, max_deviation=2, max_iter=48,
+            rng=np.random.default_rng(5), backend="device_loop",
+            mesh=make_mesh(2))
+        assert m1.pg_upmap_items == m2.pg_upmap_items
+        assert r1.moves == r2.moves
+        assert r1.stddev == r2.stddev
+        assert r1.max_deviation == r2.max_deviation
+
+    def test_mgr_option_routes_device_loop(self):
+        """upmap_state_backend="device_loop" flows through the mgr's
+        options dict unchanged — Balancer.optimize plans through the
+        one-dispatch backend."""
+        from ceph_tpu.mgr import Balancer, MappingState, \
+            synthetic_pg_stats
+
+        m = skewed(pg_num=256, n_host=4, down=4, seed=9)
+        bal = Balancer(options={"upmap_max_optimizations": 8,
+                                "upmap_max_deviation": 1,
+                                "upmap_state_backend": "device_loop",
+                                "upmap_candidate_batch": 8},
+                       rng=np.random.default_rng(3))
+        ms = MappingState(m, synthetic_pg_stats(m), mapper="host")
+        plan = bal.plan_create("t", ms, mode="upmap")
+        s0 = _loop_snap()
+        rc, _ = bal.optimize(plan)
+        s1 = _loop_snap()
+        if rc == 0:
+            assert s1["plan_dispatches"] > s0["plan_dispatches"]
+
+    def test_background_balance_off_query_path(self):
+        """serve: a background balancing round plans + applies as a
+        value-only overlay swap while lookups keep answering — the
+        plan never runs on the query path."""
+        from ceph_tpu.serve.service import PlacementService, \
+            ServeConfig
+
+        m = skewed()
+        svc = PlacementService(
+            m, config=ServeConfig(block=128, fill=256, max_queue=32,
+                                  deadline_s=5.0))
+        try:
+            base = dict(obs.perf_dump().get("serve") or {})
+            r1 = svc.background_balance(max_deviation=1, max_iter=16)
+            assert r1["ok"] and r1["num_changed"] > 0
+            # applied as a VALUE-ONLY overlay epoch
+            d = dict(obs.perf_dump().get("serve") or {})
+            assert d.get("swap_delta_applies", 0) \
+                > base.get("swap_delta_applies", 0)
+            assert d.get("swap_full_restages", 0) \
+                == base.get("swap_full_restages", 0)
+            assert d.get("background_rounds", 0) \
+                == base.get("background_rounds", 0) + 1
+            rep = svc.lookup_batch(0, np.arange(32, dtype=np.uint32))
+            assert rep.ok and rep.epoch == r1["epoch"]
+            # a second round keeps converging (fewer or zero changes)
+            r2 = svc.background_balance(max_deviation=1, max_iter=16)
+            assert r2["ok"] and r2["num_changed"] <= r1["num_changed"]
+        finally:
+            svc.close()
+
+
 # -- sharded lifetime digest identity (slow tier) ---------------------------
 
 MC_SCENARIO = (

@@ -106,7 +106,19 @@ from pathlib import Path
 #     loss path can fire) and the `ref_digest_match` backend-exactness
 #     bit.  All raw: every one is bit-determined by the seeded
 #     scenario.
-SCHEMA_VERSION = 10
+# v11: fully device-resident upmap optimizer (balancer/upmap.py
+#     backend="device_loop": the whole multi-round greedy in ONE
+#     lax.while_loop dispatch per plan).  The rebalance stage grows
+#     `plan_dispatches` (kernel dispatches across the run — O(1) per
+#     plan; a jump means the loop fell apart into per-round dispatches)
+#     and `dispatches_per_change` (plan dispatches per accepted change)
+#     — both bit-determined by the seeded run, compared raw.  The serve
+#     stage grows `background_round_p99_ms` (the live background-
+#     balancing round tail, wall-clock so calibration-normalized) and
+#     `background_query_compiles` (compiles booked in the measured
+#     background window — 0 when healthy; 0 -> N rides the structural
+#     zero-baseline rule).
+SCHEMA_VERSION = 11
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -337,6 +349,13 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
     if rounds and isinstance(rounds[0], dict):
         put("rebalance.round0_wall_s", rounds[0].get("wall_s"),
             False, True)
+    # v11: the device-loop dispatch story is seeded and bit-determined
+    # — plan_dispatches inflating means the one-dispatch plan fell
+    # apart into per-round (or per-change) kernel launches
+    put("rebalance.plan_dispatches", rb.get("plan_dispatches"),
+        False, False)
+    put("rebalance.dispatches_per_change",
+        rb.get("dispatches_per_change"), False, False)
     for span, q in (rec.get("quantiles") or {}).items():
         if isinstance(q, dict):
             put(f"quantiles.{span}.p50", q.get("p50"), False, True)
@@ -514,6 +533,13 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         out["serve.health.rank"] = (rank[sv["health"]], False, False)
     put("serve.timeline_samples", sv.get("timeline_samples"),
         True, False)
+    # v11: live background balancing — the measured round tail is
+    # wall-clock (normalized); the window's compile count is
+    # structural (0 when healthy, 0 -> N is the zero-baseline case)
+    put("serve.background_round_p99_ms",
+        sv.get("background_round_p99_ms"), False, True)
+    put("serve.background_query_compiles",
+        sv.get("background_query_compiles"), False, False)
     # multichip trajectory (normalized MULTICHIP_r*.json wrappers)
     mc = rec.get("multichip") or {}
     put("multichip.n_devices", mc.get("n_devices"), True, False)

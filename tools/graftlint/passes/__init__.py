@@ -7,6 +7,7 @@ disable=<name>` token) and a one-line `doc` (shown by `--list`).
 """
 
 from tools.graftlint.passes import (  # noqa: F401
+    balancer_options,
     counter_decl,
     env_knob,
     fault_point,

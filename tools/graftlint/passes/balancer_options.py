@@ -1,0 +1,106 @@
+"""balancer-options: the mgr's upmap_* option surface is documented
+and test-forced.
+
+`ceph_tpu/mgr/module.py` `DEFAULT_OPTIONS` is the single registry of
+balancer options; the `upmap_*` family routes straight into
+`calc_pg_upmaps` (backend selection, deviation target, change budget,
+candidate batch), so a key that drifts out of the docs or out of the
+test suite silently strands an optimizer code path.  Three drift
+directions are checked:
+
+- a `get_option("upmap_*")` call site whose key is not declared in
+  `DEFAULT_OPTIONS` (consuming an option that can never be set);
+- a declared `upmap_*` key missing from the README balancer options
+  table (the operator surface must stay documented);
+- a declared `upmap_*` key that no test module forces as a string
+  literal (an option nobody sets in a test is an optimizer branch
+  nobody runs until an operator flips it in production).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Context, Module, Pass, Violation, _load_registry, register,
+)
+
+MGR_MODULE = "ceph_tpu/mgr/module.py"
+PREFIX = "upmap_"
+
+
+def _option_sites(module: Module):
+    """Yield (key, node) for each get_option("<literal>") call."""
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        c = module.canonical(node.func)
+        if c is None or not c.endswith("get_option"):
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            yield a0.value, node
+
+
+def _string_literals(module: Module) -> set[str]:
+    return {
+        node.value
+        for node in ast.walk(module.tree)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str)
+    }
+
+
+@register
+class BalancerOptionsPass(Pass):
+    name = "balancer-options"
+    doc = "upmap_* options declared, in the README table, test-forced"
+
+    def run(self, ctx: Context) -> None:
+        declared, lines = _load_registry(
+            ctx.root / MGR_MODULE, "DEFAULT_OPTIONS", {})
+        if not declared:
+            return
+        # (a) every upmap_* consumption site uses a declared key
+        for m in ctx.modules:
+            if m.tree is None:
+                continue
+            for key, node in _option_sites(m):
+                if key.startswith(PREFIX) and key not in declared:
+                    ctx.violations.append(Violation(
+                        m.rel, node.lineno, self.name,
+                        f"option {key!r} is not declared in "
+                        "mgr/module.py DEFAULT_OPTIONS (it can never "
+                        "be set)",
+                    ))
+
+        # whole-tree facts; skip when linting a fixture subset, where
+        # the README and most call sites are out of view
+        if len(ctx.modules) < 10:
+            return
+        upmap_keys = sorted(k for k in declared if k.startswith(PREFIX))
+        # (b) every declared key rides the README options table
+        readme = ctx.root / "README.md"
+        if readme.exists():
+            text = readme.read_text()
+            for key in upmap_keys:
+                if key not in text:
+                    ctx.violations.append(Violation(
+                        "README.md", 1, self.name,
+                        f"balancer option {key!r} missing from the "
+                        "README balancer options table",
+                    ))
+        # (c) every declared key is forced by at least one test
+        if not ctx.test_modules:
+            return
+        forced: set[str] = set()
+        for tm in ctx.test_modules:
+            if tm.tree is None:
+                continue
+            forced |= _string_literals(tm)
+        for key in upmap_keys:
+            if key not in forced:
+                ctx.violations.append(Violation(
+                    MGR_MODULE, lines.get(key, 1), self.name,
+                    f"balancer option {key!r} is forced by no test — "
+                    "its optimizer path is unexercised",
+                ))
