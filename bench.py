@@ -78,10 +78,19 @@ BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
 second mapping config, selftest), BENCH_BAL_PGS/_OSDS/_COMPAT_ITERS
 (balancer stage), BENCH_LIFETIME_SCENARIO/_EPOCHS/_CK (lifetime
 stage), BENCH_SERVE_PGS/_OSDS/_SECONDS/_CLIENTS/_BLOCK/_CHAOS_EPOCHS/
-_STALL_BOUND (serve stage), plus the CEPH_TPU_FAULTS /
+_STALL_BOUND (serve stage), BENCH_FLEET_CLUSTERS/_EPOCHS/_SPEC (fleet
+stage), plus the CEPH_TPU_FAULTS /
 CEPH_TPU_LADDER / CEPH_TPU_INIT_* runtime knobs and
 CEPH_TPU_EC_STRATEGY (forces one ec.jax_backend strategy; the ec_jax
 stage measures all of them anyway).
+
+A `fleet` stage (ceph_tpu.fleet) advances >=64 heterogeneous clusters
+in lockstep — ONE vmapped accounting dispatch per epoch batch — after
+running a solo LifetimeSim oracle per member in the same stage: every
+stacked digest must be bit-identical to its oracle, steady batches
+must book 0 compiles, the aggregate cluster-epochs/s must beat the
+serial-solo baseline, and the pareto front over (cluster-years/h,
+served QPS, pg_lost, exposure) must be non-empty.
 
 A `serve` stage runs the placement serving daemon (ceph_tpu.serve)
 under seeded client load: sustained QPS + p50/p99 across live epoch
@@ -1405,6 +1414,133 @@ def bench_lifetime(h) -> dict:
     }
 
 
+DEFAULT_FLEET_BASE = (
+    "hosts=4,osds_per_host=3,racks=2,pgs=32,ec=2+1,ec_pgs=16,"
+    "chunk=256,balance_every=0,spotcheck_every=0,checkpoint_every=0,"
+    "seed=3,recovery=queue,max_backfills=4,recovery_mbps=200,"
+    "osd_mbps=400,p_pool_create=0,p_split=0"
+)
+# p_pool_create/p_split are zeroed in the BENCH base only: a chaos pool
+# create or split mints a new lane shape, which is a structural fleet
+# epoch and a stacked-executable retrace by construction — the stage
+# headline measures steady-state batching, so the sweep keeps the lane
+# structure constant after warmup (tier-1 test_fleet covers the
+# structural-churn path with the default event probabilities).
+
+
+def _fleet_spec(clusters: int, epochs: int) -> str:
+    """The default heterogeneous sweep: a 16-combo cross-product
+    (failure regime x death pressure x recovery budget x pool scale)
+    cycled up to `clusters` members — repetitions offset the seed, so
+    every member's pinned spec() is distinct."""
+    return (
+        f"base=epochs={epochs},{DEFAULT_FLEET_BASE};"
+        "axis=correlated:0|1;"
+        "axis=p_death:0.02|0.12;"
+        "axis=recovery_mbps:100|400;"
+        "axis=pgs:24|32;"
+        f"clusters={clusters}"
+    )
+
+
+def bench_fleet(h) -> dict:
+    """The `fleet` stage: >=64 heterogeneous clusters advanced through
+    ceph_tpu.fleet — ONE vmapped accounting dispatch per epoch batch —
+    with the acceptance proofs in the record:
+
+    - every member's stacked digest is bit-identical to a solo
+      `LifetimeSim` oracle of the same pinned spec, run FIRST in this
+      same stage (`digest_matches` == `clusters`);
+    - steady fleet epochs book 0 compiles (tag-equal lanes ride as
+      self-compares, so the stacked lane structure is constant);
+    - the aggregate cluster-epochs/s strictly beats the serial-solo
+      baseline those same oracle runs measured, and the pareto front
+      over (cluster-years/h, served QPS, pg_lost, exposure) is
+      non-empty.
+    """
+    from ceph_tpu.fleet import FleetSim, parse_fleet
+    from ceph_tpu.sim.lifetime import LifetimeSim
+
+    clusters = int(os.environ.get("BENCH_FLEET_CLUSTERS", 64))
+    epochs = int(os.environ.get("BENCH_FLEET_EPOCHS", 16))
+    spec = os.environ.get("BENCH_FLEET_SPEC",
+                          _fleet_spec(clusters, epochs))
+    jit0 = _jit_counters()
+
+    # solo oracle loop FIRST: per-member digests and the serial-solo
+    # baseline, same stage, same process, same machine.  Each oracle
+    # pins the same balancer backend the fleet pins, so the digests
+    # compare byte-for-byte.  Health observation is digest-invisible,
+    # but the harsher members can latch DATA_LOSS — isolate the
+    # registry exactly like the overwhelmed mini-run does.
+    obs.health.reset()
+    try:
+        solo_digests = []
+        t0 = time.perf_counter()
+        with obs.span("bench.fleet", phase="solo-oracle",
+                      clusters=clusters):
+            for m in parse_fleet(spec):
+                sim = LifetimeSim(m.scenario, backend=m.backend)
+                if m.backend == "jax":
+                    sim.balancer_options = {
+                        "upmap_state_backend": "device_loop"}
+                sim.run()
+                solo_digests.append(sim.digest)
+        serial_wall = time.perf_counter() - t0
+        h.progress({"solo_wall_s": round(serial_wall, 1)})
+
+        with obs.span("bench.fleet", phase="stacked",
+                      clusters=clusters):
+            fleet = FleetSim(parse_fleet(spec))
+            # pay the stacked compile outside the timed epochs (the
+            # fleet mirror of the solo engine's construction warmup)
+            fleet.warm()
+            out = fleet.run()
+    finally:
+        obs.health.reset()
+
+    mismatches = [m["index"] for m, d in zip(out["members"],
+                                             solo_digests)
+                  if m["digest"] != d]
+    serial_eps = (out["cluster_epochs"] / serial_wall
+                  if serial_wall else 0.0)
+    tr = out["trace_once"]
+    return {
+        "spec": spec,
+        "clusters": out["clusters"],
+        "epochs": epochs,
+        "fleet_epochs": out["fleet_epochs"],
+        "cluster_epochs": out["cluster_epochs"],
+        "stacked": out["stacked"],
+        "balancer_backend": out["balancer_backend"],
+        # the headline: aggregate stacked throughput vs the serial-solo
+        # baseline measured by the oracle loop above
+        "cluster_epochs_per_sec": out["cluster_epochs_per_sec"],
+        "serial_epochs_per_sec": round(serial_eps, 2),
+        "speedup_x": round(
+            out["cluster_epochs_per_sec"] / serial_eps, 2)
+        if serial_eps else 0.0,
+        "solo_wall_s": round(serial_wall, 1),
+        "fleet_wall_s": out["wall_s"],
+        # the exactness proof: stacked digests vs the solo oracles
+        "digest_matches": out["clusters"] - len(mismatches),
+        "digest_mismatches": mismatches[:8],
+        # the trace-once proof: steady batches book 0 compiles
+        "trace_once": tr,
+        "steady_compiles": tr["steady_compiles"],
+        "structural_epochs": tr["structural_epochs"],
+        "steady_epochs": tr["steady_epochs"],
+        # the pareto record: front instead of a point
+        "pareto_front_size": out["pareto"]["front_size"],
+        "pareto_front": out["pareto"]["front"][:8],
+        "pareto_dominated": len(out["pareto"]["dominated"]),
+        "pg_lost_total": sum(m["pg_lost"] for m in out["members"]),
+        "invariant_violations": sum(m["invariant_violations"]
+                                    for m in out["members"]),
+        "jit": _jit_delta(jit0),
+    }
+
+
 PROBE_TIMEOUT_S = float(os.environ.get(
     "BENCH_PROBE_TIMEOUT", os.environ.get("BENCH_INIT_TIMEOUT", 120)))
 
@@ -1534,6 +1670,11 @@ def worker() -> None:
     # starve the rebalance/headline stages behind it either
     sched.add("lifetime", lambda h: bench_lifetime(h), priority=75,
               est_s=230, min_budget_s=180, soft_timeout_s=330)
+    # the fleet rides right behind lifetime: its digest proof runs a
+    # solo oracle per member in the same stage, so the soft timeout
+    # bounds the double (serial + stacked) run
+    sched.add("fleet", lambda h: bench_fleet(h), priority=74,
+              est_s=90, min_budget_s=60, soft_timeout_s=240)
     # the serving daemon is the north-star heavy-traffic scenario: it
     # outranks the big mapping configs, and its soft timeout keeps a
     # wedged dispatcher from starving the stages behind it
@@ -1883,6 +2024,8 @@ def _assemble(stages: dict, notes: list[str], elapsed: float) -> dict:
         out["lifetime"] = _strip_perf(stages["lifetime"])
     if "serve" in stages:
         out["serve"] = _strip_perf(stages["serve"])
+    if "fleet" in stages:
+        out["fleet"] = _strip_perf(stages["fleet"])
     if "executables" in stages:
         out["executables"] = stages["executables"]
     q = _quantile_section(stages.get("perf") or {})
@@ -2058,11 +2201,15 @@ SELFTEST_ENV = {
     "BENCH_SERVE_PGS": "2048", "BENCH_SERVE_OSDS": "64",
     "BENCH_SERVE_SECONDS": "5", "BENCH_SERVE_CLIENTS": "2",
     "BENCH_SERVE_BLOCK": "512", "BENCH_SERVE_CHAOS_EPOCHS": "6",
+    # fleet stage: the 64-cluster acceptance floor, short lifetimes —
+    # the stage pays the solo-oracle loop AND the stacked run
+    "BENCH_FLEET_CLUSTERS": "64", "BENCH_FLEET_EPOCHS": "16",
     # generous deadline: the bound comes from the workloads being tiny,
     # not from budget-skipping stages (skips would fail the assert); the
     # 510-epoch lifetime scenario alone is ~200s of real dispatches on a
-    # throttled 2-thread container
-    "BENCH_DEADLINE_S": "480", "BENCH_HEADLINE_RESERVE": "20",
+    # throttled 2-thread container, and the fleet stage adds a 64x solo
+    # oracle loop plus the stacked run
+    "BENCH_DEADLINE_S": "600", "BENCH_HEADLINE_RESERVE": "20",
     # the survivability path under test: the configured-platform probe
     # hangs; the watchdog kills it in ~2s and the ladder degrades to cpu
     "CEPH_TPU_FAULTS": "init.auto=hang:600",
@@ -2073,7 +2220,8 @@ SELFTEST_ENV = {
 
 SELFTEST_STAGES = (
     "init", "ec_jax", "ec_clay", "crushtool_1k_32", "lifetime",
-    "serve", "testmappgs_100k_1k", "balancer", "rebalance", "headline",
+    "fleet", "serve", "testmappgs_100k_1k", "balancer", "rebalance",
+    "headline",
 )
 
 
@@ -2209,6 +2357,11 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
             "regression seeded in the fixture series (schema v11 "
             "serve.background_query_compiles 0->N zero-baseline case "
             "not folded)")
+    elif not any(d["metric"].startswith("fleet.")
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the fleet regression seeded in "
+            "the fixture series (schema v12 fleet metrics not folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -2237,13 +2390,13 @@ def selftest() -> int:
     try:
         proc = subprocess.run(
             [sys.executable, str(Path(__file__).resolve())],
-            env=env, capture_output=True, text=True, timeout=560,
+            env=env, capture_output=True, text=True, timeout=680,
         )
     except subprocess.TimeoutExpired as e:
         # the one failure mode that must still produce a verdict JSON:
         # the survivability path itself regressed into a wedge
         problems.append(
-            "selftest run wedged past 560s (survivability path "
+            "selftest run wedged past 680s (survivability path "
             f"regression?): {str(e.stderr)[-300:] if e.stderr else ''}"
         )
     else:
@@ -2423,6 +2576,45 @@ def selftest() -> int:
             problems.append(
                 "lifetime ref-backend slice digest != jax slice digest "
                 "(correlated model not backend-exact)")
+        # fleet acceptance gates (schema v12): >=64 heterogeneous
+        # clusters through ONE stacked dispatch per epoch batch, 0
+        # steady compiles, every stacked digest bit-identical to its
+        # solo oracle, aggregate throughput strictly above the
+        # serial-solo baseline measured in the same stage, and a
+        # non-empty pareto front
+        flt = out.get("fleet") or {}
+        if flt.get("clusters", 0) < 64:
+            problems.append(
+                f"fleet ran {flt.get('clusters')} clusters "
+                "(wanted >=64)")
+        if flt.get("digest_matches", -1) != flt.get("clusters", 0):
+            problems.append(
+                f"fleet stacked digests matched only "
+                f"{flt.get('digest_matches')}/{flt.get('clusters')} "
+                "solo oracles (mismatched members: "
+                f"{flt.get('digest_mismatches')})")
+        if flt.get("steady_compiles", -1) != 0:
+            problems.append(
+                f"fleet steady epoch batches booked "
+                f"{flt.get('steady_compiles')} compile(s) — the "
+                "stacked lane structure is not constant")
+        if flt.get("serial_epochs_per_sec") is None or \
+                flt.get("cluster_epochs_per_sec", 0.0) \
+                <= flt["serial_epochs_per_sec"]:
+            problems.append(
+                f"fleet stacked rate "
+                f"{flt.get('cluster_epochs_per_sec')} cluster-epochs/s "
+                "did not beat the serial-solo baseline "
+                f"({flt.get('serial_epochs_per_sec')}) measured in the "
+                "same stage")
+        if not flt.get("pareto_front_size", 0) >= 1:
+            problems.append(
+                "fleet pareto front is empty (no non-dominated member)")
+        if flt.get("invariant_violations", -1) != 0:
+            problems.append(
+                f"fleet members booked "
+                f"{flt.get('invariant_violations')} invariant "
+                "violation(s)")
         # serve acceptance gates: sustained QPS with a recorded tail
         # across live epoch swaps, zero dropped queries, swaps that
         # never stall readers past the bound, 0 steady compiles,
@@ -2611,6 +2803,15 @@ def selftest() -> int:
                      "chaos", "slo", "health", "timeline_samples",
                      "background", "background_round_p99_ms",
                      "background_query_compiles")
+        } or None,
+        "fleet": {
+            k: v for k, v in (out.get("fleet") or {}).items()
+            if k in ("clusters", "fleet_epochs", "cluster_epochs",
+                     "cluster_epochs_per_sec", "serial_epochs_per_sec",
+                     "speedup_x", "digest_matches", "steady_compiles",
+                     "structural_epochs", "steady_epochs",
+                     "pareto_front_size", "pareto_dominated",
+                     "pg_lost_total", "invariant_violations")
         } or None,
         "rebalance": {
             k: v for k, v in (out.get("rebalance") or {}).items()

@@ -1,0 +1,27 @@
+"""Fleet simulation: N independent clusters per stacked dispatch.
+
+`spec` expands one sweep-grammar string into pinned members, `engine`
+evolves them in lockstep with per-member digests bit-identical to solo
+`LifetimeSim` runs, and `pareto` reduces the outcomes into a
+non-dominated front.
+"""
+
+from ceph_tpu.fleet.engine import FleetSim
+from ceph_tpu.fleet.pareto import Point, pareto_front, triage_table
+from ceph_tpu.fleet.spec import (
+    FLEET_KNOBS,
+    SWEEP_AXES,
+    FleetMember,
+    parse_fleet,
+)
+
+__all__ = [
+    "FLEET_KNOBS",
+    "SWEEP_AXES",
+    "FleetMember",
+    "FleetSim",
+    "Point",
+    "pareto_front",
+    "parse_fleet",
+    "triage_table",
+]

@@ -1,0 +1,529 @@
+"""Fleet engine: N independent clusters, one stacked dispatch per epoch.
+
+`FleetSim` evolves many `LifetimeSim` members in lockstep.  Each fleet
+epoch runs every live member's `_step_begin` (chaos event application),
+then reduces EVERY member's per-pool mapping stats through ONE stacked
+vmapped dispatch (`_plan_pool` / `_commit_pool` are the solo engine's
+own read/write halves, so the numbers — and therefore each member's
+SHA-256 replay digest — are bit-identical to a solo run of the same
+scenario), then runs every member's `_step_finish` (recovery drain,
+workload sampling, durability ledger, digest line).
+
+Exactness of the stacking: lanes pad to the batch max over (rows, width)
+with ITEM_NONE, and every `core/reduce` reduction masks
+ITEM_NONE/negative lanes before exact-integer accumulation — the same
+mesh contract that makes the sharded solo digest equal the unsharded
+one makes the padded stacked digest equal the solo one.  n/size/tol
+ride as per-lane operand vectors, and `real = arange(Nmax) < n` masks
+the row padding, so no padded element can reach a sum.
+
+Steady-state contract: every (member, pool) lane rides EVERY epoch —
+tag-equal lanes go as self-compares whose outputs are discarded at
+commit (the solo cache-replay short-circuit still supplies their
+stats) — so the stacked executable's input structure is constant
+across steady epochs and books 0 compiles; a changed lane structure
+(pool create/split/resize, member retirement) is a structural epoch by
+construction.  Member engines receive a zero jit-delta (the shared
+batch compile cannot be attributed to ONE member); the fleet books the
+batch-level delta itself.
+
+The whole stack checkpoints atomically into ONE file (every member's
+`_state()` slice plus the pinned member list); resume refuses any
+drift in cluster count, order, or any single member's spec string with
+a per-cluster diff.
+
+Deliberately shared process state across members: `obs.health` and the
+"sim" timeline series interleave member samples (observation runs
+after the digest update, so this is digest-invisible by construction).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ceph_tpu import obs
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.fleet import pareto as pareto_mod
+from ceph_tpu.fleet.spec import FleetMember, parse_fleet
+from ceph_tpu.runtime import Checkpoint, faults
+from ceph_tpu.sim.lifetime import LifetimeSim
+from ceph_tpu.utils import knobs
+from ceph_tpu.utils.dout import subsys_logger
+
+_log = subsys_logger("sim")
+
+_FL = obs.logger_for("fleet")
+_FL.add_u64("epochs", "fleet epoch batches stepped")
+_FL.add_u64("cluster_epochs", "member cluster-epochs advanced")
+_FL.add_u64("stacked_lanes",
+            "pool lanes reduced through the stacked dispatch")
+_FL.add_u64("host_lanes",
+            "pool lanes accounted host-side (ref members or "
+            "device-loss degradation)")
+_FL.add_u64("structural_epochs",
+            "fleet epochs with a structural member epoch or a changed "
+            "lane structure")
+_FL.add_u64("steady_epochs",
+            "fleet epochs with unchanged lane structure")
+_FL.add_u64("steady_compiles",
+            "compiles booked during steady fleet epochs (contract: 0)")
+_FL.add_u64("checkpoints", "fleet stack checkpoints flushed")
+_FL.add_time_avg("epoch_seconds", "one fleet epoch batch wall time")
+
+
+def _build_stack_account():
+    """The stacked reducer: tuple-of-lanes in, [L, 6] stats + per-lane
+    moved rows out.  Pure restack of `lifetime._epoch_stats`'s formula
+    set under vmap — the two must never diverge (per-member digest
+    equality depends on it), which is why the body calls the same
+    `core/reduce` helpers the solo kernel does."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.core import reduce
+
+    def _lane_stats(prev, rows, n, size, tol):
+        real = jnp.arange(rows.shape[0]) < n
+        occ = reduce.result_sizes(rows)
+        size = size.astype(jnp.int32)
+        tol = tol.astype(jnp.int32)
+        degraded = jnp.sum((real & (occ < size)).astype(jnp.int64))
+        unmapped = jnp.sum((real & (occ == 0)).astype(jnp.int64))
+        at_risk = jnp.sum(
+            (real & (occ < size - tol)).astype(jnp.int64))
+        dup = jnp.sum(
+            (real & reduce.duplicate_rows(rows)).astype(jnp.int64))
+        moved_rows = jnp.sum(
+            (reduce.moved_in_lanes(prev, rows) & real[:, None])
+            .astype(jnp.int64), axis=1)
+        moved = jnp.sum(moved_rows)
+        remapped = jnp.sum(
+            (real & reduce.changed_rows(prev, rows))
+            .astype(jnp.int64))
+        return jnp.stack(
+            [degraded, unmapped, at_risk, dup, moved, remapped]), \
+            moved_rows
+
+    def _stacked(prevs, rowss, ns, sizes, tols):
+        nmax = max(r.shape[0] for r in rowss)
+        wmax = max(r.shape[1] for r in rowss)
+
+        def pad(x):
+            return jnp.pad(
+                x, ((0, nmax - x.shape[0]), (0, wmax - x.shape[1])),
+                constant_values=ITEM_NONE)
+
+        sp = jnp.stack([pad(p) for p in prevs])
+        sr = jnp.stack([pad(r) for r in rowss])
+        stats, moved = jax.vmap(_lane_stats)(sp, sr, ns, sizes, tols)
+        # each lane's moved rows slice back to its natural row count
+        # (static shapes): the recovery queue enqueues from them at the
+        # same shape the solo kernel would have produced
+        moved_out = tuple(moved[i, :r.shape[0]]
+                          for i, r in enumerate(rowss))
+        return stats, moved_out
+
+    def _key(prevs, rowss, ns, sizes, tols):
+        # the default signature maps tuples to "tuple": it cannot see
+        # the per-lane shapes that actually drive retraces
+        return (tuple((tuple(p.shape), str(p.dtype)) for p in prevs),
+                tuple((tuple(r.shape), str(r.dtype)) for r in rowss),
+                tuple(ns.shape))
+
+    return obs.JitAccount(jax.jit(_stacked), _FL, "stack_stats",
+                          key_fn=_key)
+
+
+_STACK_ACCT = None
+
+
+def _stack_account():
+    global _STACK_ACCT
+    if _STACK_ACCT is None:
+        _STACK_ACCT = _build_stack_account()
+    return _STACK_ACCT
+
+
+def _zero_delta() -> dict:
+    return {"compiles": 0, "cache_hits": 0, "retraces": 0,
+            "pipe_cache_hits": 0, "pipe_cache_misses": 0}
+
+
+def _spec_diff(have: str, want: str) -> list[str]:
+    """Per-field diff of two Scenario.spec() strings (field order is
+    fixed by the dataclass, so a dict compare is complete)."""
+    ha = dict(it.split("=", 1) for it in have.split(",") if "=" in it)
+    wa = dict(it.split("=", 1) for it in want.split(",") if "=" in it)
+    out = []
+    for k in list(ha) + [k for k in wa if k not in ha]:
+        if ha.get(k) != wa.get(k):
+            out.append(f"{k}: checkpoint {ha.get(k)!r} != "
+                       f"requested {wa.get(k)!r}")
+    if not out and have != want:
+        out.append(f"spec: checkpoint {have!r} != requested {want!r}")
+    return out
+
+
+class FleetSim:
+    """N pinned clusters advanced in lockstep through one stacked
+    dispatch per epoch batch."""
+
+    def __init__(self, members: list[FleetMember], checkpoint=None,
+                 resume: bool = False, mesh=None,
+                 balancer_backend: str | None = "device_loop"):
+        if not members:
+            raise ValueError("fleet has no members")
+        self.members = list(members)
+        self.mesh = mesh
+        self.balancer_backend = balancer_backend
+        self.stack = knobs.get("CEPH_TPU_FLEET_STACK", "1") != "0"
+        self.checkpoint_every = int(
+            knobs.get("CEPH_TPU_FLEET_CHECKPOINT_EVERY", "50"))
+        self.steps = 0
+        self.structural_epochs = 0
+        self.steady_epochs = 0
+        self.steady_compiles = 0
+        self.steady_pipe_misses = 0
+        self.total_compiles = 0
+        self.resumed_from: int | None = None
+        self._cluster_epochs = 0
+        self._cluster_epochs_this_proc = 0
+        self._wall_this_proc = 0.0
+        self._prev_sig = None
+
+        self.ck = Checkpoint(checkpoint, resume=resume) \
+            if checkpoint else None
+        state = (self.ck.data.get("fleet")
+                 if (self.ck is not None and resume) else None)
+        if resume and state is None:
+            raise ValueError(
+                f"--resume: checkpoint {checkpoint!r} has no fleet "
+                "state to resume from")
+        slices: list[dict | None] = [None] * len(self.members)
+        if state is not None:
+            self._validate_resume(state)
+            self.steps = int(state["epoch"])
+            self.resumed_from = self.steps
+            c = state.get("counters") or {}
+            self.structural_epochs = int(c.get("structural_epochs", 0))
+            self.steady_epochs = int(c.get("steady_epochs", 0))
+            self.steady_compiles = int(c.get("steady_compiles", 0))
+            self.steady_pipe_misses = int(
+                c.get("steady_pipe_misses", 0))
+            self.total_compiles = int(c.get("total_compiles", 0))
+            self._cluster_epochs = int(c.get("cluster_epochs", 0))
+            slices = list(state["clusters"])
+        self.engines: list[LifetimeSim] = []
+        for m, sl in zip(self.members, slices):
+            sim = LifetimeSim(m.scenario, backend=m.backend,
+                              mesh=mesh, restore_state=sl)
+            if m.backend == "jax" and balancer_backend:
+                sim.balancer_options = {
+                    "upmap_state_backend": balancer_backend}
+            self.engines.append(sim)
+        if state is not None:
+            _log(1, f"fleet resumed at epoch {self.steps} "
+                    f"({len(self.engines)} clusters)")
+
+    @classmethod
+    def from_spec(cls, spec: str, **kw) -> "FleetSim":
+        return cls(parse_fleet(spec), **kw)
+
+    # -- checkpoint/resume -------------------------------------------------
+
+    def _validate_resume(self, state: dict) -> None:
+        want = [(m.scenario.spec(), m.backend) for m in self.members]
+        have = [(c["scenario"], c["backend"])
+                for c in state.get("members", [])]
+        diffs = []
+        if len(have) != len(want):
+            diffs.append(f"cluster count: checkpoint {len(have)} != "
+                         f"requested {len(want)}")
+        for i in range(min(len(have), len(want))):
+            hs, hb = have[i]
+            ws, wb = want[i]
+            for line in _spec_diff(hs, ws):
+                diffs.append(f"cluster {i}: {line}")
+            if hb != wb:
+                diffs.append(f"cluster {i}: backend: checkpoint "
+                             f"{hb!r} != requested {wb!r}")
+        if diffs:
+            raise ValueError(
+                "fleet checkpoint does not match the requested fleet "
+                "(count, order, and every member's pinned spec must be "
+                "identical):\n  " + "\n  ".join(diffs))
+
+    def _state(self) -> dict:
+        return {
+            "epoch": self.steps,
+            "members": [{"index": m.index,
+                         "scenario": m.scenario.spec(),
+                         "backend": m.backend}
+                        for m in self.members],
+            "clusters": [sim._state() for sim in self.engines],
+            "counters": {
+                "structural_epochs": self.structural_epochs,
+                "steady_epochs": self.steady_epochs,
+                "steady_compiles": self.steady_compiles,
+                "steady_pipe_misses": self.steady_pipe_misses,
+                "total_compiles": self.total_compiles,
+                "cluster_epochs": self._cluster_epochs,
+            },
+        }
+
+    def checkpoint(self) -> None:
+        if self.ck is None:
+            return
+        self.ck.progress("fleet", self._state())
+        _FL.inc("checkpoints")
+        obs.instant("fleet.checkpoint", epoch=self.steps)
+
+    # -- stepping ----------------------------------------------------------
+
+    def live(self) -> list[LifetimeSim]:
+        return [s for s in self.engines
+                if s.steps < s.scenario.epochs]
+
+    def warm(self) -> None:
+        """Dispatch the stacked reducer once over the current lane
+        structure (every lane as a self-compare, outputs discarded) so
+        the first timed epoch runs warm — the fleet-level mirror of the
+        solo engine's construction-time `_baseline` warmup."""
+        if not self.stack:
+            return
+        lanes = []
+        for sim in self.live():
+            if sim.backend != "jax" or sim.state is None:
+                continue
+            for pid in sorted(sim.m.pools):
+                try:
+                    lane, _ = sim._plan_pool(pid)
+                except Exception as exc:
+                    if not faults.looks_like_device_loss(exc):
+                        raise
+                    continue
+                lanes.append(dict(lane, prev=lane["rows"]))
+        if not lanes:
+            return
+        try:
+            stats, _ = self._dispatch(lanes)
+            np.asarray(stats)
+        except Exception as exc:
+            if not faults.looks_like_device_loss(exc):
+                raise
+
+    def _dispatch(self, lanes: list[dict]):
+        import jax.numpy as jnp
+
+        prevs = tuple(l["prev"] for l in lanes)
+        rowss = tuple(l["rows"] for l in lanes)
+        ns = jnp.asarray([l["n"] for l in lanes], jnp.uint32)
+        sizes = jnp.asarray([l["size"] for l in lanes], jnp.int32)
+        tols = jnp.asarray([l["tol"] for l in lanes], jnp.int32)
+        return _stack_account()(prevs, rowss, ns, sizes, tols)
+
+    def _account(self, ctxs: list) -> dict:
+        """Account every begun member's epoch: host engines through
+        their own `_account_epoch`, stacked engines through one shared
+        dispatch.  Returns {id(sim): (stats, skeys)}."""
+        plans: dict[int, tuple] = {}
+        lanes: list[tuple] = []  # (sim, lane) in dispatch order
+        stacked_sims = []
+        for sim, ctx in ctxs:
+            e = ctx["e"]
+            if not (self.stack and sim.backend == "jax"
+                    and sim.state is not None):
+                st, sk = sim._account_epoch(e)
+                plans[id(sim)] = (st, set(sk))
+                _FL.inc("host_lanes", len(st))
+                continue
+            stacked_sims.append(sim)
+            stats: dict[int, dict] = {}
+            skeys: set = set()
+            for pid in sorted(sim.m.pools):
+                try:
+                    faults.check("epoch_apply", qual=str(e))
+                    lane, skey = sim._plan_pool(pid)
+                except Exception as exc:
+                    if not faults.looks_like_device_loss(exc):
+                        raise
+                    sim._record_fallback(e, pid, exc)
+                    st, skey = sim._account_pool(pid,
+                                                 force_host=True)
+                    stats[pid] = st
+                    skeys.add(skey)
+                    _FL.inc("host_lanes")
+                    continue
+                lanes.append((sim, lane))
+                skeys.add(skey)
+            plans[id(sim)] = (stats, skeys)
+        if lanes:
+            try:
+                stats_dev, moved = self._dispatch(
+                    [lane for _, lane in lanes])
+                stats_np = obs.timed_fetch(_FL, "stack_stats",
+                                           stats_dev)
+            except Exception as exc:
+                if not faults.looks_like_device_loss(exc):
+                    raise
+                # whole-batch device loss: every planned lane degrades
+                # to the bit-exact host path, same digest
+                for sim, lane in lanes:
+                    sim._record_fallback(sim.steps + 1, lane["pid"],
+                                         exc)
+                    st, _ = sim._account_pool(lane["pid"],
+                                              force_host=True)
+                    plans[id(sim)][0][lane["pid"]] = st
+                    _FL.inc("host_lanes")
+            else:
+                _FL.inc("stacked_lanes", len(lanes))
+                for j, (sim, lane) in enumerate(lanes):
+                    st = sim._commit_pool(lane, stats_np[j], moved[j])
+                    plans[id(sim)][0][lane["pid"]] = st
+        for sim in stacked_sims:
+            sim._prune_removed_pools()
+        return {k: (st, frozenset(sk))
+                for k, (st, sk) in plans.items()}
+
+    def step(self) -> list[dict]:
+        """One fleet epoch: every live member advances one lifetime
+        epoch; all stacked accounting rides one dispatch.  Returns the
+        per-member step records (in member order)."""
+        live = self.live()
+        if not live:
+            return []
+        t0 = time.perf_counter()
+        jit0 = obs.jit_counters()
+        fspan = obs.span("fleet.epoch", epoch=self.steps + 1,
+                         clusters=len(live))
+        fspan.__enter__()
+        ctxs: list[tuple] = []   # begun, not yet finished
+        recs: list[dict] = []
+        try:
+            for sim in live:
+                ctxs.append((sim, sim._step_begin(None)))
+            plans = self._account(ctxs)
+            for sim, ctx in list(ctxs):
+                stats, skeys = plans[id(sim)]
+                rec = sim._step_finish(ctx, stats, skeys,
+                                       jit_delta=_zero_delta())
+                ctxs.remove((sim, ctx))   # its span is closed now
+                recs.append(rec)
+        except BaseException:
+            for _, ctx in ctxs:
+                ctx["span"].__exit__(None, None, None)
+            fspan.__exit__(None, None, None)
+            raise
+        fspan.__exit__(None, None, None)
+        jd = obs.jit_counters_delta(jit0)
+        compiles = jd["compiles"] + jd["retraces"]
+        sig = tuple((id(sim), plans[id(sim)][1]) for sim in live)
+        structural = (any(r["structural"] for r in recs)
+                      or self._prev_sig is None
+                      or sig != self._prev_sig)
+        self._prev_sig = sig
+        self.total_compiles += compiles
+        if structural:
+            self.structural_epochs += 1
+            _FL.inc("structural_epochs")
+        else:
+            self.steady_epochs += 1
+            self.steady_compiles += compiles
+            self.steady_pipe_misses += jd["pipe_cache_misses"]
+            _FL.inc("steady_epochs")
+            if compiles:
+                _FL.inc("steady_compiles", compiles)
+                _log(1, f"fleet epoch {self.steps + 1}: steady batch "
+                        f"booked {compiles} compile(s) — stacked "
+                        "structure contract broken")
+        self.steps += 1
+        self._cluster_epochs += len(live)
+        self._cluster_epochs_this_proc += len(live)
+        wall = time.perf_counter() - t0
+        self._wall_this_proc += wall
+        _FL.inc("epochs")
+        _FL.inc("cluster_epochs", len(live))
+        _FL.observe("epoch_seconds", wall)
+        if (self.ck is not None and self.checkpoint_every
+                and self.steps % self.checkpoint_every == 0):
+            self.checkpoint()
+        return recs
+
+    def run(self, epochs: int | None = None,
+            stop_after: int | None = None) -> dict:
+        total = epochs if epochs is not None \
+            else max(m.scenario.epochs for m in self.members)
+        while self.steps < total and self.live():
+            if stop_after is not None and self.steps >= stop_after:
+                break
+            self.step()
+        self.checkpoint()
+        return self.summary()
+
+    # -- reporting ---------------------------------------------------------
+
+    def digests(self) -> list[str]:
+        return [sim.digest for sim in self.engines]
+
+    def points(self) -> list[pareto_mod.Point]:
+        """Per-member pareto points with front/dominated accounting
+        resolved (feeds `pareto.triage_table`)."""
+        pts = [pareto_mod.Point.from_summary(
+            m.index, m.scenario.spec(), sim.summary())
+            for m, sim in zip(self.members, self.engines)]
+        pareto_mod.pareto_front(pts)
+        return pts
+
+    def summary(self) -> dict:
+        member_rows = []
+        points = []
+        for m, sim in zip(self.members, self.engines):
+            s = sim.summary()
+            p = pareto_mod.Point.from_summary(
+                m.index, m.scenario.spec(), s)
+            points.append(p)
+            member_rows.append({
+                "index": m.index,
+                "scenario": m.scenario.spec(),
+                "backend": m.backend,
+                "epochs": sim.steps,
+                "digest": sim.digest,
+                "steady_compiles": sim.steady_compiles,
+                "invariant_violations": len(sim.violations),
+                "pg_lost": sim.pg_lost_total,
+                "pareto": dict(p.values),
+            })
+        front, dominated = pareto_mod.pareto_front(points)
+        wall = self._wall_this_proc
+        out = {
+            "clusters": len(self.engines),
+            "fleet_epochs": self.steps,
+            "cluster_epochs": self._cluster_epochs,
+            "stacked": self.stack,
+            "balancer_backend": self.balancer_backend,
+            "trace_once": {
+                "structural_epochs": self.structural_epochs,
+                "steady_epochs": self.steady_epochs,
+                "steady_compiles": self.steady_compiles,
+                "steady_pipe_misses": self.steady_pipe_misses,
+                "total_compiles": self.total_compiles,
+            },
+            "wall_s": round(wall, 3),
+            "cluster_epochs_per_sec": round(
+                self._cluster_epochs_this_proc / wall, 2
+            ) if wall else 0.0,
+            "members": member_rows,
+            "pareto": {
+                "front": [dict(p.values, index=p.index)
+                          for p in front],
+                "front_size": len(front),
+                "dominated": [{"index": p.index,
+                               "dominated_by": p.dominated_by}
+                              for p in dominated],
+            },
+        }
+        if self.resumed_from is not None:
+            out["resumed_from"] = self.resumed_from
+        return out

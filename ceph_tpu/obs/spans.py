@@ -97,6 +97,11 @@ SPANS: dict[str, str] = {
                     "samples through the placement rows + contention "
                     "accounting",
     "bench.lifetime": "lifetime bench stage body",
+    # fleet/ — N clusters per stacked dispatch
+    "fleet.epoch": "one fleet epoch batch: every live member's chaos "
+                   "event + ONE stacked accounting dispatch + data "
+                   "planes + digests",
+    "bench.fleet": "fleet bench stage body",
     "bench.multichip": "multichip bench: mesh-sharded map/lifetime/"
                        "optimizer measurements for one device count",
     # serve/ — the placement serving daemon
@@ -127,6 +132,7 @@ INSTANTS: dict[str, str] = {
     "runtime.acquired": "backend acquisition finished",
     "sharded.make_mesh": "device mesh construction",
     "sim.checkpoint": "a lifetime-sim checkpoint was flushed",
+    "fleet.checkpoint": "a whole-stack fleet checkpoint was flushed",
     "serve.swap_applied": "an epoch swap flipped the active buffer",
     "serve.degraded": "serve dispatch lost the device; batch answered "
                       "by the host mapper",

@@ -591,7 +591,7 @@ class LifetimeSim:
     def __init__(self, scenario: Scenario | str | None = None,
                  backend: str = "jax",
                  checkpoint: str | None = None, resume: bool = False,
-                 mesh=None):
+                 mesh=None, restore_state: dict | None = None):
         if isinstance(scenario, str) or scenario is None:
             scenario = Scenario.parse(scenario)
         self.scenario = scenario
@@ -707,11 +707,22 @@ class LifetimeSim:
         # test hook: host-path row corruption for invariant negative
         # controls (fn(pid, rows_np) -> rows_np); None in production
         self.corrupt_hook = None
+        # extra mgr Balancer options merged into every _balance round
+        # (the fleet engine pins upmap_state_backend="device_loop" here
+        # so the whole fleet's balancer cadence rides the PR 18
+        # one-dispatch optimizer; part of engine behavior, so a solo
+        # digest oracle must set the same options)
+        self.balancer_options: dict = {}
 
         self.ck = Checkpoint(checkpoint, resume=resume) \
             if checkpoint else None
-        state = (self.ck.data.get("lifetime")
-                 if (self.ck is not None and resume) else None)
+        # restore_state: an externally-held _state() dict (the fleet
+        # engine checkpoints the whole stack in ONE file and hands each
+        # member its slice); otherwise the engine's own checkpoint
+        state = restore_state
+        if state is None:
+            state = (self.ck.data.get("lifetime")
+                     if (self.ck is not None and resume) else None)
         if state:
             self._restore(state)
         else:
@@ -1043,6 +1054,65 @@ class LifetimeSim:
         st["tol"] = tol
         return st, skey
 
+    # The fleet engine (ceph_tpu.fleet) reduces MANY engines' pools in
+    # one stacked vmapped dispatch.  _plan_pool/_commit_pool are the
+    # read and write halves of _account_pool's device path, split so
+    # the dispatch between them can be batched across engines; they
+    # must stay exact mirrors of _account_pool — per-member digest
+    # equality between a solo run and a fleet run depends on it.
+
+    def _plan_pool(self, pid: int):
+        """Read half (device path only): version-tagged rows, the
+        tag-equal short-circuit decision, and the prev operand, WITHOUT
+        dispatching.  Returns (lane, skey); `lane["cached"]` non-None
+        means the stats replay from cache (the lane still rides the
+        stacked dispatch as a self-compare so the batch structure stays
+        fixed across steady epochs — its outputs are discarded)."""
+        import jax.numpy as jnp
+
+        pool = self.m.pools[pid]
+        tol = self._pool_tolerance(pool)
+        rows, skey, tag = self.state.rows(pid)
+        prev = self._prev_rows.get(pid)
+        cached = self._stats_cache.get(pid)
+        lane = {"pid": pid, "rows": rows, "n": pool.pg_num,
+                "size": pool.size, "tol": tol, "tag": tag,
+                "cached": None}
+        if (prev is not None and prev[0] == tag
+                and cached is not None and cached[0] == tag
+                and cached[1]["tol"] == tol):
+            lane["cached"] = dict(cached[1]["stats"],
+                                  moved=0, remapped=0)
+            lane["prev"] = rows  # self-compare: outputs discarded
+        elif (prev is None
+                or tuple(prev[1].shape) != tuple(rows.shape)):
+            lane["prev"] = rows  # fresh/resized pool: self-compare
+        else:
+            lane["prev"] = prev[1] if not isinstance(
+                prev[1], np.ndarray) else jnp.asarray(prev[1])
+        return lane, skey
+
+    def _commit_pool(self, lane: dict, out, moved_rows) -> dict:
+        """Write half: book one lane's stacked-dispatch outputs (`out`
+        the fetched 6-stat row, `moved_rows` the device-resident per-PG
+        moved lanes) into the same caches the solo path maintains."""
+        pid, tag = lane["pid"], lane["tag"]
+        if lane["cached"] is not None:
+            st = lane["cached"]
+            self._moved[pid] = None  # tag-equal rows: nothing moved
+        else:
+            st = {k: int(v) for k, v in zip(STAT_KEYS, out)}
+            self._moved[pid] = moved_rows  # stays device-resident
+            self._stats_cache[pid] = (tag, {
+                "tol": lane["tol"],
+                "stats": {k: st[k] for k in self._ROW_STATS},
+            })
+        self._prev_rows[pid] = (tag, lane["rows"])
+        st["n"] = lane["n"]
+        st["size"] = lane["size"]
+        st["tol"] = lane["tol"]
+        return st
+
     def _record_fallback(self, e: int, pid, exc) -> None:
         _device_loss_counter().inc("device_loss_fallbacks")
         msg = f"epoch {e} pool {pid}: {exc} -> host mapper"
@@ -1066,7 +1136,12 @@ class LifetimeSim:
                 st, skey = self._account_pool(pid, force_host=True)
             stats[pid] = st
             skeys.add(skey)
-        # removed pools leave no stale prev rows behind
+        self._prune_removed_pools()
+        return stats, frozenset(skeys)
+
+    def _prune_removed_pools(self) -> None:
+        """Removed pools leave no stale prev rows (or queue/durability
+        state) behind."""
         for pid in list(self._prev_rows):
             if pid not in self.m.pools:
                 del self._prev_rows[pid]
@@ -1077,7 +1152,6 @@ class LifetimeSim:
                 self.lost.pop(pid, None)  # pg_lost_total stays booked
                 if self.recovery is not None:
                     self.recovery.drop(pid)
-        return stats, frozenset(skeys)
 
     # -- invariants --------------------------------------------------------
 
@@ -1551,7 +1625,8 @@ class LifetimeSim:
         try:
             bal = Balancer(
                 options={"upmap_max_optimizations":
-                         self.scenario.balance_max},
+                         self.scenario.balance_max,
+                         **self.balancer_options},
                 rng=np.random.default_rng(
                     [self.scenario.seed, e, 1]),
             )
@@ -1868,22 +1943,61 @@ class LifetimeSim:
         ))
 
     def step(self, force_event: str | None = None) -> dict:
+        ctx = self._step_begin(force_event)
+        try:
+            stats, skeys = self._account_epoch(ctx["e"])
+        except BaseException:
+            ctx["span"].__exit__(None, None, None)
+            raise
+        return self._step_finish(ctx, stats, skeys)
+
+    def _step_begin(self, force_event: str | None = None) -> dict:
+        """First half of one epoch, up to (not including) the mapping
+        accounting: fault gate, the epoch's seeded rng, compile/rebuild
+        snapshots, event application.  Split out so the fleet engine
+        (ceph_tpu.fleet) can run MANY engines' accounting through one
+        stacked dispatch between begin and finish; `step()` composes
+        begin/account/finish into the unchanged solo behavior."""
         e = self.steps + 1
         faults.check("lifetime_step", qual=str(e))
         rng = np.random.default_rng([self.scenario.seed, e])
-        t0 = time.perf_counter()
-        jit0 = obs.jit_counters()
-        rb0 = self.state.full_rebuilds if self.state is not None else 0
+        ctx = {
+            "e": e, "rng": rng,
+            "t0": time.perf_counter(),
+            "jit0": obs.jit_counters(),
+            "rb0": (self.state.full_rebuilds
+                    if self.state is not None else 0),
+        }
         self._structural_apply = False
-        with obs.span("sim.epoch", epoch=e):
+        span = obs.span("sim.epoch", epoch=e)
+        span.__enter__()
+        ctx["span"] = span
+        try:
             event = self._apply_event(e, rng, force_event)
             if event.startswith("balance"):
                 bal_key = (self._prev_skeys, self._overlay_presence())
-                structural_hint = bal_key != self._last_balance_key
+                ctx["hint"] = bal_key != self._last_balance_key
                 self._last_balance_key = bal_key
             else:
-                structural_hint = False
-            stats, skeys = self._account_epoch(e)
+                ctx["hint"] = False
+        except BaseException:
+            span.__exit__(None, None, None)
+            raise
+        ctx["event"] = event
+        return ctx
+
+    def _step_finish(self, ctx: dict, stats: dict, skeys: frozenset,
+                     jit_delta: dict | None = None) -> dict:
+        """Second half of one epoch: data planes, integration,
+        invariants, structural classification, the digest line, and
+        observation.  `jit_delta` overrides the measured compile delta:
+        the fleet engine passes zeros for its member engines (the
+        process-global jit counters cannot attribute the shared stacked
+        dispatch to ONE member) and books the batch-level delta itself.
+        """
+        e, rng, event = ctx["e"], ctx["rng"], ctx["event"]
+        t0 = ctx["t0"]
+        try:
             wl = (self._workload_epoch(e)
                   if self.workload is not None else None)
             rec = (self._recovery_epoch(e, stats)
@@ -1892,11 +2006,16 @@ class LifetimeSim:
                    if self.scenario.correlated else None)
             epoch_s = self._integrate(stats, rec)
             self._invariants(e, rng, stats)
-        jd = obs.jit_counters_delta(jit0)
+        except BaseException:
+            ctx["span"].__exit__(None, None, None)
+            raise
+        ctx["span"].__exit__(None, None, None)
+        jd = (jit_delta if jit_delta is not None
+              else obs.jit_counters_delta(ctx["jit0"]))
         compiles = jd["compiles"] + jd["retraces"]
-        rebuilds = (self.state.full_rebuilds - rb0
+        rebuilds = (self.state.full_rebuilds - ctx["rb0"]
                     if self.state is not None else 0)
-        structural = (structural_hint
+        structural = (ctx["hint"]
                       or self._structural_apply
                       or self._prev_skeys is None
                       or skeys != self._prev_skeys)

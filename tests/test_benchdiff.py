@@ -134,6 +134,13 @@ def test_seeded_regressions_flagged():
         "rebalance.plan_dispatches",           # 2 -> 20
         "rebalance.dispatches_per_change",     # 0.1 -> 1.0
         "serve.background_query_compiles",     # 0 -> 3: zero baseline
+        # fleet simulator (v12, seeded in r21->r22): stacked digests
+        # stopped matching the solo oracles, the stacked dispatch
+        # started compiling in steady state, and the pareto front went
+        # empty — all bit-determined by the seeded members, raw
+        "fleet.digest_matches",                # 64 -> 49
+        "fleet.steady_compiles",               # 0 -> 5: zero baseline
+        "fleet.pareto_front_size",             # 3 -> 0
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -341,6 +348,43 @@ def test_deviceloop_fixture_pair_v11():
     assert not any(
         d["metric"].startswith(("rebalance.", "serve.background"))
         for d in rep2["regressions"])
+
+
+def test_fleet_fixture_pair_v12():
+    """The v12 seeded pair in isolation: the healthy fleet round (r21,
+    every stacked digest bit-identical to its solo oracle, 0 steady
+    compiles, a 3-point pareto front) against the regression (r22: 15
+    digests diverged, the stacked dispatch compiled in steady state,
+    the front went empty, and the aggregate rate collapsed).  The
+    digest/compile/front counts are bit-determined by the seeded
+    members — raw; the cluster-epochs rate is a hardware number — same
+    calibration, so it flags as a same-machine semantic slowdown."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r21"], by["r22"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    for name in ("fleet.digest_matches", "fleet.pareto_front_size"):
+        assert name in flagged, name
+        assert not flagged[name]["normalized"]  # structural: raw
+    assert flagged["fleet.digest_matches"]["prev"] == 64
+    assert flagged["fleet.digest_matches"]["cur"] == 49
+    d = flagged["fleet.steady_compiles"]
+    assert not d["normalized"]
+    assert d["prev"] == 0 and d["cur"] == 5
+    assert d["change"] is None          # zero baseline: no finite pct
+    assert "fleet.cluster_epochs_per_sec" in flagged
+    assert flagged["fleet.cluster_epochs_per_sec"]["normalized"]
+    # the healthy record alone extracts the full v12 shape
+    m = extract_metrics(by["r21"].record)
+    assert m["fleet.cluster_epochs_per_sec"] == (120.0, True, True)
+    assert m["fleet.digest_matches"] == (64.0, True, False)
+    assert m["fleet.steady_compiles"] == (0.0, False, False)
+    assert m["fleet.pareto_front_size"] == (3.0, True, False)
+    # the healthy direction (r20 regression recovering into r21) never
+    # flags a fleet metric
+    rep2 = diff_series([by["r20"], by["r21"]])
+    assert not any(d["metric"].startswith("fleet.")
+                   for d in rep2["regressions"])
 
 
 def test_healthy_calibrated_rounds_are_clean():

@@ -1,0 +1,466 @@
+"""Fleet simulator: sweep-grammar expansion, stacked-dispatch digest
+equivalence against the solo oracle, pareto-front reduction, atomic
+whole-stack checkpoint/resume refusal, and the CLI triage surface.
+
+The digest tests are the contract that matters: every member of a
+stacked fleet must land on the SAME SHA-256 replay digest a solo
+`LifetimeSim` of the identical scenario produces — including a
+`correlated=1` member and a member whose starved recovery pipe loses
+PGs (the DATA_LOSS latch must survive stacking).  Tier-1 keeps the
+fleet small; the 64-cluster acceptance-scale sweep is slow-marked.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import fields
+
+import pytest
+
+from ceph_tpu import obs
+from ceph_tpu.cli import fleet as fleet_cli
+from ceph_tpu.fleet.engine import FleetSim
+from ceph_tpu.fleet.pareto import (
+    Point,
+    dominates,
+    pareto_front,
+    triage_table,
+)
+from ceph_tpu.fleet.spec import (
+    FLEET_KNOBS,
+    SWEEP_AXES,
+    parse_fleet,
+)
+from ceph_tpu.runtime import faults
+from ceph_tpu.sim.lifetime import LifetimeSim, Scenario
+
+BASE = ("epochs=8,seed=5,hosts=4,osds_per_host=3,racks=2,pgs=32,"
+        "ec=2+1,ec_pgs=16,chunk=256,balance_every=0,spotcheck_every=0,"
+        "checkpoint_every=0,recovery=queue,max_backfills=4,"
+        "recovery_mbps=200,osd_mbps=400")
+
+# the proven loss scenario (test_correlated's overwhelmed pipe) as a
+# cluster override: a starved pipe under a brutal death rate loses PGs
+LOSS = ("epochs=14,hosts=3,osds_per_host=2,racks=1,pgs=16,ec_pgs=8,"
+        "chunk=64,seed=7,p_death=0.25,p_flap=0.05,p_host_outage=0.10,"
+        "p_reweight=0,p_pg_temp=0,p_pool_create=0,p_split=0,"
+        "p_expand=0,p_remove=0.02,max_backfills=1,recovery_mbps=2,"
+        "osd_mbps=4,correlated=1,flappers=1")
+
+# 4 heterogeneous members: plain, balanced, correlated, and data-loss
+DIGEST_SPEC = (f"base={BASE};"
+               "axis=correlated:0|1;"
+               "axis=recovery_mbps:100|400;"
+               "cluster=1:balance_every=3;"
+               f"cluster=3:{LOSS}")
+
+# small all-host fleet for checkpoint and CLI smoke (fast, no device)
+REF_SPEC = (f"base={BASE},epochs=6;"
+            "axis=seed:1|2;axis=p_death:0.02|0.1;"
+            "backend=ref")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.health.reset()
+    yield
+    faults.disarm_all()
+    obs.health.reset()
+
+
+def _solo_digest(member) -> str:
+    """The oracle: a solo LifetimeSim of the member's pinned scenario
+    (same balancer state backend the fleet pins for jax members)."""
+    sim = LifetimeSim(Scenario.parse(member.scenario.spec()),
+                      backend=member.backend)
+    if member.backend == "jax":
+        sim.balancer_options = {"upmap_state_backend": "device_loop"}
+    return sim.run()["digest"]
+
+
+# ------------------------------------------------------- sweep grammar
+
+
+def test_sweep_axes_are_scenario_fields():
+    """Runtime mirror of the graftlint sweep-grammar pass: every
+    registered axis names a real Scenario field and no fleet knob
+    shadows one (the grammar could not tell the two apart)."""
+    names = {f.name for f in fields(Scenario)}
+    for key in SWEEP_AXES:
+        assert key in names, key
+    for key in FLEET_KNOBS:
+        assert key not in names, key
+
+
+def test_readme_sweep_table_covers_every_key():
+    import pathlib
+
+    readme = (pathlib.Path(__file__).resolve().parents[1]
+              / "README.md").read_text()
+    for key in list(SWEEP_AXES) + list(FLEET_KNOBS):
+        assert f"| `{key}` |" in readme, (
+            f"{key} missing from README sweep-grammar table")
+
+
+def test_parse_fleet_sweeps_every_registered_axis():
+    """One spec sweeping EVERY registered axis parses, and expansion
+    order is the cross-product with the last axis varying fastest."""
+    spec = (f"base={BASE};"
+            "axis=seed:1|2;axis=epochs:6|8;axis=pgs:16|32;"
+            "axis=ec:2+1|4+2;axis=ec_pgs:8|16;axis=hosts:3|4;"
+            "axis=p_flap:0|0.05;axis=p_death:0|0.1;"
+            "axis=correlated:0|1;axis=recovery_mbps:100|400;"
+            "axis=max_backfills:1|4;axis=osd_mbps:200|400;"
+            "axis=balance_every:0|4;axis=workload:0|1;"
+            "axis=base_qps:500|1000;"
+            "clusters=4")
+    ms = parse_fleet(spec)
+    assert len(ms) == 4
+    assert ms[0].scenario.seed == 1 and ms[0].scenario.workload == 0
+    # last axis (base_qps) varies fastest
+    assert ms[0].scenario.base_qps == 500.0
+    assert ms[1].scenario.base_qps == 1000.0
+    assert ms[1].scenario.workload == 0
+    assert ms[2].scenario.workload == 1
+    specs = [m.spec() for m in ms]
+    assert len(set(specs)) == 4
+
+
+def test_clusters_cycle_offsets_seed_per_repetition():
+    ms = parse_fleet(f"base={BASE};axis=p_death:0.02|0.1;clusters=5")
+    assert len(ms) == 5
+    assert [m.scenario.seed for m in ms] == [5, 5, 6, 6, 7]
+    assert len({m.spec() for m in ms}) == 5
+    # a swept seed is pinned: repetitions beyond the combos are clones
+    dup = parse_fleet(f"base={BASE};axis=seed:1|2;clusters=4")
+    assert dup[0].spec() == dup[2].spec()
+    assert dup[1].spec() == dup[3].spec()
+
+
+def test_cluster_overrides_and_backend_knob():
+    ms = parse_fleet(f"base={BASE};axis=seed:1|2;backend=ref;"
+                     "cluster=1:p_flap=0.5,backend=jax")
+    assert [m.backend for m in ms] == ["ref", "jax"]
+    assert ms[0].scenario.p_flap != 0.5
+    assert ms[1].scenario.p_flap == 0.5
+    # overrides pin the rendered spec string
+    assert "p_flap=0.5" in ms[1].spec()
+
+
+def test_parse_fleet_error_cases():
+    # unregistered axis built dynamically: a bare `axis=flappers:`
+    # literal here would itself trip the sweep-grammar reverse scan
+    with pytest.raises(ValueError, match="unknown sweep axis"):
+        parse_fleet(f"base={BASE};axis=flap" + "pers:1|2")
+    with pytest.raises(ValueError, match="bad fleet directive"):
+        parse_fleet("nonsense")
+    with pytest.raises(ValueError, match="sweeps no values"):
+        parse_fleet(f"base={BASE};axis=seed:|")
+    with pytest.raises(ValueError, match="bad axis directive"):
+        parse_fleet(f"base={BASE};axis=seed")
+    with pytest.raises(ValueError, match="beyond the fleet size"):
+        parse_fleet(f"base={BASE};cluster=7:seed=1")
+    with pytest.raises(ValueError, match="neither a Scenario field"):
+        parse_fleet(f"base={BASE};cluster=0:bogus_field=1")
+    with pytest.raises(ValueError, match="want jax or ref"):
+        parse_fleet(f"base={BASE};backend=gpu")
+    with pytest.raises(ValueError, match="want >= 1"):
+        parse_fleet(f"base={BASE};clusters=0")
+    with pytest.raises(ValueError, match="no members"):
+        FleetSim([])
+
+
+# --------------------------------------------------------------- pareto
+
+
+def _pt(i, cyrs, qps, lost, exp):
+    return Point(index=i, spec=f"s{i}", values={
+        "cluster_years_per_hour": cyrs, "served_qps": qps,
+        "pg_lost": lost, "exposure": exp})
+
+
+def test_dominates_needs_strict_improvement():
+    a = _pt(0, 1.0, 100.0, 0, 0)
+    b = _pt(1, 1.0, 100.0, 0, 0)
+    assert not dominates(a.values, b.values)  # equal: no strict edge
+    c = _pt(2, 1.0, 100.0, 1, 0)
+    assert dominates(a.values, c.values)      # fewer PGs lost
+    assert not dominates(c.values, a.values)
+    d = _pt(3, 2.0, 50.0, 0, 0)               # trade-off: incomparable
+    assert not dominates(a.values, d.values)
+    assert not dominates(d.values, a.values)
+
+
+def test_pareto_front_accounts_dominated_points():
+    pts = [_pt(0, 2.0, 100.0, 0, 0),   # front
+           _pt(1, 1.0, 50.0, 2, 10),   # dominated by 0
+           _pt(2, 0.5, 200.0, 0, 0)]   # front (best qps)
+    front, dominated = pareto_front(pts)
+    assert [p.index for p in front] == [0, 2]
+    assert [p.index for p in dominated] == [1]
+    assert dominated[0].dominated_by == 0
+
+
+def test_triage_table_renders_front_first():
+    pts = [_pt(0, 1.0, 50.0, 2, 10), _pt(1, 2.0, 100.0, 0, 0)]
+    pareto_front(pts)
+    table = triage_table(pts)
+    lines = table.splitlines()
+    assert "beaten-by" in lines[0]
+    assert lines[1].startswith("1")  # front member leads
+    assert "front 1 / dominated 1 of 2 clusters" in table
+
+
+def test_point_from_summary_reads_durability_ledger():
+    p = Point.from_summary(3, "spec", {
+        "pareto": {"cluster_years_per_hour": 1.5, "served_qps": 42.0},
+        "durability": {"pg_lost": 2, "exposure_pg_epochs": 7},
+    })
+    assert p.values == {"cluster_years_per_hour": 1.5,
+                       "served_qps": 42.0, "pg_lost": 2.0,
+                       "exposure": 7.0}
+
+
+# -------------------------------------------- stacked digest equivalence
+
+
+def test_fleet_digests_match_solo_oracle():
+    """The tentpole contract: every member of the stacked fleet —
+    plain, balancer-driven, correlated, and the data-loss cluster —
+    lands bit-identically on its solo oracle digest, steady epochs book
+    0 compiles, and the DATA_LOSS latch survives stacking."""
+    members = parse_fleet(DIGEST_SPEC)
+    assert len(members) == 4
+    assert members[2].scenario.correlated == 1
+    solo = {}
+    for m in members:
+        solo[m.index] = _solo_digest(m)
+        obs.health.reset()
+
+    fleet = FleetSim(parse_fleet(DIGEST_SPEC))
+    fleet.warm()
+    out = fleet.run()
+    assert out["clusters"] == 4
+    for row in out["members"]:
+        assert row["digest"] == solo[row["index"]], (
+            f"cluster {row['index']} ({row['scenario'][:60]}...) "
+            "diverged from its solo oracle")
+        assert row["invariant_violations"] == 0
+    # the loss member lost PGs and the latch survived the stacking
+    assert out["members"][3]["pg_lost"] > 0
+    chk = obs.health.checks().get("DATA_LOSS")
+    assert chk and chk["severity"] == obs.health.ERR
+    # trace-once: steady epochs booked zero compiles
+    t = out["trace_once"]
+    assert t["steady_compiles"] == 0
+    assert t["structural_epochs"] + t["steady_epochs"] \
+        == out["fleet_epochs"]
+    # the front is never empty (a non-dominated point always exists)
+    assert out["pareto"]["front_size"] >= 1
+    assert out["pareto"]["front_size"] \
+        + len(out["pareto"]["dominated"]) == 4
+
+
+def test_fleet_unstacked_matches_stacked(monkeypatch):
+    """CEPH_TPU_FLEET_STACK=0 solo-steps every member — same digests,
+    no stacked dispatch (the knob is a debugging escape hatch, not a
+    semantics switch)."""
+    spec = f"base={BASE},epochs=5;axis=correlated:0|1"
+    stacked = FleetSim(parse_fleet(spec))
+    stacked.warm()
+    a = stacked.run()
+    monkeypatch.setenv("CEPH_TPU_FLEET_STACK", "0")
+    solo = FleetSim(parse_fleet(spec))
+    assert not solo.stack
+    b = solo.run()
+    assert [m["digest"] for m in a["members"]] \
+        == [m["digest"] for m in b["members"]]
+
+
+@pytest.mark.slow
+def test_fleet_64_clusters_digest_equivalence():
+    """Acceptance scale: a 64-cluster heterogeneous sweep (4 axes x 4
+    seed repetitions) where EVERY stacked digest matches its solo
+    oracle and steady epochs book 0 compiles."""
+    spec = (f"base={BASE},epochs=5,seed=3;"
+            "axis=correlated:0|1;axis=p_death:0.02|0.12;"
+            "axis=recovery_mbps:100|400;axis=pgs:24|32;"
+            "clusters=64")
+    members = parse_fleet(spec)
+    assert len({m.spec() for m in members}) == 64
+    solo = {m.index: _solo_digest(m) for m in members}
+    obs.health.reset()
+    fleet = FleetSim(parse_fleet(spec))
+    fleet.warm()
+    out = fleet.run()
+    mismatches = [r["index"] for r in out["members"]
+                  if r["digest"] != solo[r["index"]]]
+    assert mismatches == []
+    assert out["trace_once"]["steady_compiles"] == 0
+    assert out["cluster_epochs"] == 64 * 5
+
+
+# ------------------------------------------------- checkpoint / resume
+
+
+def test_fleet_checkpoint_resume_roundtrip(tmp_path):
+    straight = FleetSim(parse_fleet(REF_SPEC)).run()
+    ck = tmp_path / "fleet.json"
+    a = FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck))
+    a.run(stop_after=3)
+    assert a.steps == 3
+    b = FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck),
+                 resume=True)
+    assert b.resumed_from == 3
+    out = b.run()
+    assert out["resumed_from"] == 3
+    assert [m["digest"] for m in out["members"]] \
+        == [m["digest"] for m in straight["members"]]
+
+
+def test_fleet_resume_refuses_count_mismatch(tmp_path):
+    ck = tmp_path / "fleet.json"
+    FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck)).run(
+        stop_after=2)
+    smaller = f"base={BASE},epochs=6;axis=seed:1|2;backend=ref"
+    with pytest.raises(ValueError, match="cluster count"):
+        FleetSim(parse_fleet(smaller), checkpoint=str(ck),
+                 resume=True)
+
+
+def test_fleet_resume_refuses_order_drift(tmp_path):
+    ck = tmp_path / "fleet.json"
+    FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck)).run(
+        stop_after=2)
+    reordered = parse_fleet(REF_SPEC)
+    reordered[1], reordered[2] = reordered[2], reordered[1]
+    with pytest.raises(ValueError) as ei:
+        FleetSim(reordered, checkpoint=str(ck), resume=True)
+    msg = str(ei.value)
+    assert "cluster 1" in msg and "cluster 2" in msg
+    assert "checkpoint" in msg and "requested" in msg
+
+
+def test_fleet_resume_refuses_single_spec_drift(tmp_path):
+    """Any one member's field drifting kills the resume with a
+    per-cluster, per-field diff naming both values."""
+    ck = tmp_path / "fleet.json"
+    FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck)).run(
+        stop_after=2)
+    drifted = parse_fleet(REF_SPEC + ";cluster=2:recovery_mbps=50")
+    with pytest.raises(ValueError) as ei:
+        FleetSim(drifted, checkpoint=str(ck), resume=True)
+    msg = str(ei.value)
+    assert "cluster 2: recovery_mbps" in msg
+    assert "'200.0'" in msg and "'50.0'" in msg
+    assert "cluster 0" not in msg and "cluster 1" not in msg
+
+
+def test_fleet_resume_refuses_backend_drift(tmp_path):
+    ck = tmp_path / "fleet.json"
+    FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck)).run(
+        stop_after=2)
+    drifted = parse_fleet(REF_SPEC)
+    drifted[0].backend = "jax"
+    with pytest.raises(ValueError, match="cluster 0: backend"):
+        FleetSim(drifted, checkpoint=str(ck), resume=True)
+
+
+def test_fleet_resume_needs_fleet_state(tmp_path):
+    ck = tmp_path / "empty.json"
+    with pytest.raises(ValueError, match="no fleet state"):
+        FleetSim(parse_fleet(REF_SPEC), checkpoint=str(ck),
+                 resume=True)
+
+
+def test_fleet_fault_kill_mid_cascade_then_resume(tmp_path):
+    """The registry-documented kill site at fleet scale: one member is
+    mid-cascade (open hazard windows) when an armed `hazard_decay`
+    fault kills the whole stack; the atomic checkpoint still holds the
+    pre-decay strengths and the resumed fleet replays every member to
+    the straight run's digests."""
+    spec = (f"base={BASE},epochs=12,correlated=1,flappers=2,"
+            "p_host_outage=0.3,p_rack_outage=0.1;"
+            "axis=seed:11|12;backend=ref")
+    straight = FleetSim(parse_fleet(spec)).run()
+
+    # probe member 0 solo (same trajectory) for the first epoch with
+    # open hazard windows — seeded, so deterministic
+    probe_sc = parse_fleet(spec)[0].scenario
+    probe = LifetimeSim(Scenario.parse(probe_sc.spec()), backend="ref")
+    stop = None
+    for e in range(1, probe_sc.epochs - 2):
+        probe.step()
+        if probe.hazards:
+            stop = e
+            break
+    assert stop is not None, "scenario opened no hazard window"
+
+    ck = tmp_path / "fleet.json"
+    a = FleetSim(parse_fleet(spec), checkpoint=str(ck))
+    a.run(stop_after=stop)
+    assert a.engines[0].hazards, \
+        "interrupt point lost its active hazard windows"
+    faults.arm("hazard_decay", "fail", "mid-cascade fleet kill", 1)
+    with pytest.raises(faults.FaultInjected):
+        a.step()
+    faults.disarm("hazard_decay")
+
+    b = FleetSim(parse_fleet(spec), checkpoint=str(ck), resume=True)
+    assert b.resumed_from == stop
+    assert b.engines[0].hazards, \
+        "checkpoint lost the active hazard windows"
+    out = b.run()
+    assert [m["digest"] for m in out["members"]] \
+        == [m["digest"] for m in straight["members"]]
+
+
+# ------------------------------------------------------------------ cli
+
+
+def test_cli_run_smoke(capsys):
+    rc = fleet_cli.main(["run", "--spec", REF_SPEC])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "clusters        4" in out
+    assert "cluster-epochs/s" in out
+    assert "steady compile(s)" in out
+    assert "pareto" in out and "invariants      0 violation(s)" in out
+
+
+def test_cli_run_json_parses(capsys):
+    rc = fleet_cli.main(["run", "--spec", REF_SPEC, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out)
+    assert rec["clusters"] == 4
+    assert len(rec["members"]) == 4
+    assert rec["pareto"]["front_size"] >= 1
+    for m in rec["members"]:
+        assert m["digest"]
+
+
+def test_cli_pareto_triage_table(capsys):
+    rc = fleet_cli.main(["pareto", "--spec", REF_SPEC])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "beaten-by" in out
+    assert "of 4 clusters" in out
+
+
+def test_cli_digest_lines(capsys):
+    rc = fleet_cli.main(["digest", "--spec", REF_SPEC])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 4
+    for i, ln in enumerate(lines):
+        idx, digest = ln.split()
+        assert int(idx) == i
+        assert len(digest) >= 16
+
+
+def test_cli_resume_requires_checkpoint(capsys):
+    rc = fleet_cli.main(["run", "--resume"])
+    assert rc == 2
+    assert "--resume needs --checkpoint" in capsys.readouterr().err

@@ -498,6 +498,68 @@ def test_scenario_event_flags_untested_declared_kind():
     assert v.path == "ceph_tpu/sim/lifetime.py"
 
 
+# -- sweep-grammar ----------------------------------------------------------
+
+def test_sweep_grammar_fires_on_unregistered_axis_literal(tmp_path):
+    """Direction (a): an `axis=<key>:` literal sweeping a key outside
+    SWEEP_AXES/FLEET_KNOBS fires (it would raise at parse time);
+    registered keys and the docs' `axis=key:` placeholder are silent."""
+    # built dynamically: a bare bogus literal here would itself be
+    # flagged by the repo-wide scan (this file lives in tests/)
+    bogus = "axis=zz_bog" + "us:1|2"
+    v = lint(tmp_path, (
+        f"SPEC = 'base=epochs=4;{bogus};axis=seed:1|2'\n"
+        "DOC = 'axis=key:v1|v2'\n"
+    ), "sweep-grammar")
+    assert len(v) == 1 and v[0].line == 1
+    assert "zz_bogus" in v[0].message
+    assert "unregistered" in v[0].message
+
+
+def test_sweep_grammar_fires_on_knob_shadowing_field():
+    """A fleet knob named like a Scenario field makes the grammar
+    ambiguous — the pass refuses it at the registry line."""
+    ctx = Context(paths=[], include_tests=False)
+    ctx.fleet_knobs = dict(ctx.fleet_knobs, seed="shadow")
+    ctx.fleet_knob_lines = dict(ctx.fleet_knob_lines, seed=1)
+    PASSES["sweep-grammar"].run(ctx)
+    assert len(ctx.violations) == 1, ctx.violations
+    assert "shadows a Scenario field" in ctx.violations[0].message
+    assert ctx.violations[0].path == "ceph_tpu/fleet/spec.py"
+
+
+def test_sweep_grammar_flags_undocumented_untested_axis():
+    """Directions (b)+(c)+(d): a salted axis that is not a Scenario
+    field, missing from the README table, and swept by no test fires
+    all three ways — and every *real* key is clean (no other
+    violations)."""
+    key = "zz_" + "phantom"
+    ctx = Context()  # full scan: README and tests/ in view
+    ctx.sweep_axes = dict(ctx.sweep_axes, **{key: "never"})
+    ctx.sweep_lines = dict(ctx.sweep_lines, **{key: 1})
+    PASSES["sweep-grammar"].run(ctx)
+    assert len(ctx.violations) == 3, ctx.violations
+    msgs = [v.message for v in ctx.violations]
+    assert any(key in m and "not a Scenario" in m for m in msgs)
+    assert any(key in m and "README" in m for m in msgs)
+    assert any(key in m and "swept by no test" in m for m in msgs)
+
+
+def test_sweep_grammar_flags_untested_fleet_knob():
+    """A declared fleet knob needs a README row and a `<key>=` directive
+    literal in some test — a salted knob fires both; the real knobs are
+    all covered."""
+    key = "zz_" + "knob"
+    ctx = Context()  # full scan
+    ctx.fleet_knobs = dict(ctx.fleet_knobs, **{key: "never"})
+    ctx.fleet_knob_lines = dict(ctx.fleet_knob_lines, **{key: 1})
+    PASSES["sweep-grammar"].run(ctx)
+    assert len(ctx.violations) == 2, ctx.violations
+    msgs = [v.message for v in ctx.violations]
+    assert any(key in m and "README" in m for m in msgs)
+    assert any(key in m and "exercised by no test" in m for m in msgs)
+
+
 # -- balancer-options -------------------------------------------------------
 
 def test_balancer_options_fires_on_undeclared_key(tmp_path):

@@ -397,7 +397,7 @@ def test_bench_selftest():
     there)."""
     proc = subprocess.run(
         [sys.executable, str(REPO / "bench.py"), "--selftest"],
-        capture_output=True, text=True, timeout=700,
+        capture_output=True, text=True, timeout=900,
         cwd=str(REPO),
         env={k: v for k, v in os.environ.items()
              if k not in ("BENCH_WORKER", "BENCH_REQUIRE_TPU")},

@@ -39,6 +39,7 @@ KNOB_REGISTRY = "ceph_tpu/utils/knobs.py"
 FAULT_REGISTRY = "ceph_tpu/runtime/faults.py"
 HEALTH_REGISTRY = "ceph_tpu/obs/health.py"
 EVENT_REGISTRY = "ceph_tpu/sim/lifetime.py"
+SWEEP_REGISTRY = "ceph_tpu/fleet/spec.py"
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,-]+)")
 
@@ -234,6 +235,10 @@ class Context:
             self.root / HEALTH_REGISTRY, "HEALTH_CHECKS", {})
         self.event_kinds, self.event_lines = _load_registry(
             self.root / EVENT_REGISTRY, "EVENT_KINDS", {})
+        self.sweep_axes, self.sweep_lines = _load_registry(
+            self.root / SWEEP_REGISTRY, "SWEEP_AXES", {})
+        self.fleet_knobs, self.fleet_knob_lines = _load_registry(
+            self.root / SWEEP_REGISTRY, "FLEET_KNOBS", {})
 
     @property
     def test_modules(self) -> list[Module]:

@@ -16,5 +16,6 @@ from tools.graftlint.passes import (  # noqa: F401
     no_print,
     scenario_event,
     span_name,
+    sweep_grammar,
     trace_constant,
 )

@@ -1,0 +1,153 @@
+"""sweep-grammar: the fleet sweep-axis registry maps to real Scenario
+fields, is documented, and every axis is exercised.
+
+`fleet/spec.py` declares the fleet sweep surface as two pure-literal
+registries: `SWEEP_AXES` (keys an `axis=key:v1|v2|...` directive may
+sweep — each MUST name a `dataclasses.fields(Scenario)` field) and
+`FLEET_KNOBS` (fleet-level member keys that are deliberately NOT
+Scenario fields — a knob shadowing a field would make the grammar
+ambiguous).  Mirroring the `scenario-event` pass, the directions are:
+
+- every `SWEEP_AXES` key names a real Scenario dataclass field (read
+  statically from `sim/lifetime.py`'s AnnAssign list — never imported);
+- no `FLEET_KNOBS` key shadows a Scenario field;
+- every registered key appears in the README sweep-grammar table as a
+  ``| `key` |`` row;
+- every `SWEEP_AXES` key is forced by at least one test (an
+  `axis=<key>:` substring inside a test string literal) and every
+  `FLEET_KNOBS` key by a `<key>=` directive literal — an axis the
+  suite never sweeps is grammar no digest has ever pinned;
+- the reverse: an `axis=<key>:` literal anywhere (tree or tests) whose
+  key is unregistered would raise at runtime — flag it statically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.engine import (
+    EVENT_REGISTRY, SWEEP_REGISTRY, Context, Module, Pass, Violation,
+    register,
+)
+
+_AXIS_RE = re.compile(r"axis=([a-z_][a-z0-9_]*):")
+
+
+def _scenario_fields(ctx: Context) -> set[str]:
+    """Scenario dataclass field names, read statically out of
+    sim/lifetime.py (same file as the event registry)."""
+    path = ctx.root / EVENT_REGISTRY
+    if not path.exists():
+        return set()
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Scenario":
+            return {
+                stmt.target.id for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+    return set()
+
+
+def _string_literals(modules: list[Module]):
+    for m in modules:
+        if m.tree is None:
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                yield m, node
+
+
+@register
+class SweepGrammarPass(Pass):
+    name = "sweep-grammar"
+    doc = "fleet sweep axes are real Scenario fields, in README, tested"
+
+    def run(self, ctx: Context) -> None:
+        if not ctx.sweep_axes and not ctx.fleet_knobs:
+            return
+        fields = _scenario_fields(ctx)
+        known = set(ctx.sweep_axes) | set(ctx.fleet_knobs)
+
+        if fields:
+            for key in sorted(ctx.sweep_axes):
+                if key not in fields:
+                    ctx.violations.append(Violation(
+                        SWEEP_REGISTRY, ctx.sweep_lines.get(key, 1),
+                        self.name,
+                        f"sweep axis {key!r} is not a Scenario "
+                        "dataclass field — the axis can never pin a "
+                        "member spec",
+                    ))
+            for key in sorted(ctx.fleet_knobs):
+                if key in fields:
+                    ctx.violations.append(Violation(
+                        SWEEP_REGISTRY,
+                        ctx.fleet_knob_lines.get(key, 1), self.name,
+                        f"fleet knob {key!r} shadows a Scenario field "
+                        "— the grammar cannot tell the two apart",
+                    ))
+
+        # an axis literal sweeping an unregistered key raises at parse
+        # time — catch it statically, in the tree AND the tests
+        for m, node in _string_literals(
+                list(ctx.modules) + list(ctx.test_modules)):
+            for match in _AXIS_RE.finditer(node.value):
+                if match.group(1) == "key":
+                    continue  # the docs' grammar placeholder
+                if match.group(1) not in known:
+                    ctx.violations.append(Violation(
+                        m.rel, node.lineno, self.name,
+                        f"axis literal sweeps unregistered key "
+                        f"{match.group(1)!r} (declared: "
+                        f"{sorted(known)})",
+                    ))
+
+        # registry-side drift (whole-tree facts; skip when linting a
+        # fixture subset, where most call sites are out of view)
+        if len(ctx.modules) < 10:
+            return
+        readme = ctx.root / "README.md"
+        if readme.exists():
+            text = readme.read_text()
+            for key in sorted(known):
+                if f"| `{key}` |" not in text:
+                    line_map = (ctx.sweep_lines
+                                if key in ctx.sweep_axes
+                                else ctx.fleet_knob_lines)
+                    ctx.violations.append(Violation(
+                        "README.md", 1, self.name,
+                        f"sweep-grammar key {key!r} (fleet/spec.py:"
+                        f"{line_map.get(key, 1)}) missing from the "
+                        "README sweep-grammar table",
+                    ))
+        if not ctx.test_modules:
+            return
+        swept: set[str] = set()
+        directive: set[str] = set()
+        for _, node in _string_literals(ctx.test_modules):
+            for match in _AXIS_RE.finditer(node.value):
+                swept.add(match.group(1))
+            for key in ctx.fleet_knobs:
+                if f"{key}=" in node.value:
+                    directive.add(key)
+        for key in sorted(ctx.sweep_axes):
+            if key not in swept:
+                ctx.violations.append(Violation(
+                    SWEEP_REGISTRY, ctx.sweep_lines.get(key, 1),
+                    self.name,
+                    f"sweep axis {key!r} is swept by no test "
+                    f"(`axis={key}:...` literal) — grammar no digest "
+                    "has ever pinned",
+                ))
+        for key in sorted(ctx.fleet_knobs):
+            if key not in directive:
+                ctx.violations.append(Violation(
+                    SWEEP_REGISTRY, ctx.fleet_knob_lines.get(key, 1),
+                    self.name,
+                    f"fleet knob {key!r} is exercised by no test "
+                    f"(`{key}=...` directive literal)",
+                ))
