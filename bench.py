@@ -78,7 +78,8 @@ BENCH_SKIP_EC, BENCH_PROBE_TIMEOUT, BENCH_CFG2_PGS/_OSDS (shrink the
 second mapping config, selftest), BENCH_BAL_PGS/_OSDS/_COMPAT_ITERS
 (balancer stage), BENCH_LIFETIME_SCENARIO/_EPOCHS/_CK (lifetime
 stage), BENCH_SERVE_PGS/_OSDS/_SECONDS/_CLIENTS/_BLOCK/_CHAOS_EPOCHS/
-_STALL_BOUND (serve stage), BENCH_FLEET_CLUSTERS/_EPOCHS/_SPEC (fleet
+_STALL_BOUND/_BULK_SECONDS/_FRONT_BLOCKS/_MESH_PGS (serve stage),
+BENCH_FLEET_CLUSTERS/_EPOCHS/_SPEC (fleet
 stage), plus the CEPH_TPU_FAULTS /
 CEPH_TPU_LADDER / CEPH_TPU_INIT_* runtime knobs and
 CEPH_TPU_EC_STRATEGY (forces one ec.jax_backend strategy; the ec_jax
@@ -920,7 +921,21 @@ def bench_serve(h) -> dict:
     overlay staging warm OFF the query path; then clients run while two
     more rounds plan + apply live — the whole window must book 0
     compiles (query path and background rounds both ride warm caches),
-    and the client p99 stays recorded."""
+    and the client p99 stays recorded.
+
+    Phase E (bulk edge + mesh + front, schema v13): a scalar
+    `submit()` window measures the per-lookup protocol edge, then two
+    bulk clients drive `query_block` while a FORCED structural swap
+    (an upmap overlay adopted mid-window) lands — the pre-traced
+    overlay variants must keep the window compile-free and the flip
+    under the structural stall bound (`structural_swap_stalls` delta
+    0), with bulk qps >= 10x the scalar edge and zero shed lanes.  A
+    mesh leg re-answers the same placement set in a subprocess with 2
+    forced host devices (CEPH_TPU_MESH_DEVICES) and compares placement
+    digests — bit-identity across shardings.  A 2-replica ServeFront
+    absorbs an injected one-replica stall: every lane still answers
+    ok, the stalled replica sheds, and the client-visible block p99 is
+    recorded."""
     import threading
 
     from ceph_tpu.runtime import faults
@@ -1172,6 +1187,190 @@ def bench_serve(h) -> dict:
             res["background"]["query_compiles"]
     finally:
         svc2.close()
+    h.progress(res)
+
+    # -- phase E: bulk protocol edge + forced structural swap ----------
+    from ceph_tpu.osd.state import value_copy_map
+    from ceph_tpu.osd.types import PgId
+    from ceph_tpu.serve.front import ServeFront
+    from ceph_tpu.serve.meshcheck import build_default, placement_digest
+
+    bulk_seconds = float(os.environ.get("BENCH_SERVE_BULK_SECONDS",
+                                        max(2.0, seconds / 2)))
+    mesh_pgs = int(os.environ.get("BENCH_SERVE_MESH_PGS", 64))
+    svc3 = PlacementService(m, config=cfg, name="bench.serve.bulk")
+    try:
+        bmax = max(cfg.bulk_max, cfg.block)
+        seeds = (np.arange(bmax, dtype=np.uint32) * 7) % pgs
+        svc3.query_block(0, seeds, deadline_s=60.0)  # warm both shapes
+        # pre-seed ONE structural adopt (width-1 overlay) off the
+        # measured window: the first overlay epoch pays ClusterState
+        # construction for an overlay-carrying map; the MEASURED swap
+        # below re-keys to the width-2 variant, which the constructor
+        # prewarm already traced — that flip must be free
+        mu0 = value_copy_map(svc3._active.m)
+        mu0.epoch += 1
+        mu0.pg_upmap_items = dict(mu0.pg_upmap_items)
+        mu0.pg_upmap_items[PgId(0, 0)] = [(0, 0)]
+        pre_swap = svc3.adopt_map(mu0, reason="bench preseed overlay")
+        svc3.query_block(0, seeds, deadline_s=60.0)  # warm post-flip
+
+        # scalar protocol edge: per-lookup submit() through the queued
+        # micro-batcher — the dispatcher overhead the bulk edge
+        # amortizes away
+        stop = threading.Event()
+        scalar_ok = [0, 0]
+
+        def scalar_client(i):
+            srng = np.random.default_rng(100 + i)
+            while not stop.is_set():
+                if svc3.submit(0, int(srng.integers(0, pgs)),
+                               deadline_s=30.0).ok:
+                    scalar_ok[i] += 1
+
+        ths = [threading.Thread(target=scalar_client, args=(i,))
+               for i in range(2)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        time.sleep(max(1.0, bulk_seconds / 2))
+        stop.set()
+        for t in ths:
+            t.join(timeout=30)
+        scalar_qps = sum(scalar_ok) / (time.perf_counter() - t0)
+
+        sv_e0 = dict(obs.perf_dump().get("serve") or {})
+        jit_e = _jit_counters()
+        stop = threading.Event()
+        lanes_ok = [0, 0]
+        lanes_not_ok = [0, 0]
+
+        def bulk_client(i):
+            s = (seeds + i) % pgs
+            while not stop.is_set():
+                c = svc3.query_block(0, s, deadline_s=60.0).counts()
+                lanes_ok[i] += c.get("ok", 0)
+                lanes_not_ok[i] += sum(
+                    v for k, v in c.items() if k != "ok")
+
+        ths = [threading.Thread(target=bulk_client, args=(i,))
+               for i in range(2)]
+        with obs.span("bench.serve", phase="bulk"):
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            time.sleep(bulk_seconds / 2)
+            # the forced STRUCTURAL swap: a second PG picks up a
+            # width-2 composed pair mid-window — pipeline re-keys to
+            # the prewarmed variant, readers never see the staging
+            mu = value_copy_map(svc3._active.m)
+            mu.epoch += 1
+            mu.pg_upmap_items = dict(mu.pg_upmap_items)
+            mu.pg_upmap_items[PgId(0, 1)] = [(0, 0), (1, 1)]
+            swap = svc3.adopt_map(mu, reason="bench forced structural")
+            time.sleep(bulk_seconds / 2)
+            stop.set()
+            for t in ths:
+                t.join(timeout=60)
+            bulk_wall = time.perf_counter() - t0
+        bulk_jit = _jit_delta(jit_e)
+        sv_e1 = dict(obs.perf_dump().get("serve") or {})
+        bulk_qps = sum(lanes_ok) / bulk_wall
+        res["structural_swap_stalls"] = _d(sv_e0, sv_e1,
+                                           "structural_swap_stalls")
+        res["bulk"] = {
+            "qps": round(bulk_qps, 1),
+            "scalar_qps": round(scalar_qps, 1),
+            "ratio": round(bulk_qps / scalar_qps, 1)
+            if scalar_qps else None,
+            "lookups_ok": sum(lanes_ok),
+            "not_ok": sum(lanes_not_ok),
+            "block_lanes": bmax,
+            "compiles": bulk_jit["compiles"] + bulk_jit["retraces"],
+            "preseed_swap_ok": bool(pre_swap.get("ok")),
+            "swap_ok": bool(swap.get("ok")),
+            "swap_stall_s": swap.get("swap_stall_s"),
+        }
+    finally:
+        svc3.close()
+    h.progress(res)
+
+    # mesh bit-identity: the same placement set answered in-process
+    # (however many devices this process sees) and in a subprocess with
+    # 2 FORCED host devices sharding the serving buffer's PG axis —
+    # the sha256 placement digests must match bit-for-bit
+    mesh_m = build_default(pgs=mesh_pgs, osds=8)
+    msvc = PlacementService(
+        mesh_m, config=ServeConfig(block=128, max_queue=64,
+                                   deadline_s=0, bulk_max=mesh_pgs,
+                                   prewarm=False),
+        name="bench.serve.mesh")
+    try:
+        digest1, oracle1 = placement_digest(msvc, mesh_m)
+    finally:
+        msvc.close()
+    # the subprocess is a bit-identity witness, not a fault-injection
+    # target: drop inherited injected faults (the selftest's init hang
+    # would stall its ladder probe) and pin the ladder to cpu
+    menv = dict(os.environ, JAX_PLATFORMS="cpu",
+                CEPH_TPU_LADDER="cpu",
+                CEPH_TPU_MESH_DEVICES="2",
+                XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    menv.pop("CEPH_TPU_FAULTS", None)
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.serve.meshcheck",
+             "--pgs", str(mesh_pgs), "--osds", "8"],
+            env=menv, capture_output=True, text=True, timeout=300,
+            cwd=str(_HERE))
+        mrec = json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        mrec = {"error": f"{type(e).__name__}: {e}"[:200]}
+    res["mesh"] = {
+        "pgs": mesh_pgs,
+        "devices": mrec.get("devices"),
+        "digest_match": mrec.get("digest") == digest1,
+        "oracle_match_1dev": bool(oracle1),
+        "oracle_match_ndev": bool(mrec.get("oracle_match")),
+        "provenance": (mrec.get("mesh") or {}).get("provenance"),
+        "error": mrec.get("error"),
+    }
+    h.progress(res)
+
+    # multi-replica front: a one-replica injected stall is absorbed —
+    # the replica sheds out of routing after one slow block, every
+    # lane still answers ok, and the client-visible p99 is recorded
+    front = ServeFront(m, replicas=2, config=cfg, name="bench.front")
+    try:
+        fseeds = (np.arange(cfg.block, dtype=np.uint32) * 5) % pgs
+        for _ in range(3):  # settle the per-replica latency EWMAs
+            front.query_block(0, fseeds, deadline_s=60.0)
+        nblocks = int(os.environ.get("BENCH_SERVE_FRONT_BLOCKS", 20))
+        fok = fbad = 0
+        faults.arm(f"serve_dispatch.{front.name}.r1", "stall", "0.5", 2)
+        try:
+            with obs.span("bench.serve", phase="front"):
+                for _ in range(nblocks):
+                    c = front.query_block(0, fseeds,
+                                          deadline_s=60.0).counts()
+                    fok += c.get("ok", 0)
+                    fbad += sum(v for k, v in c.items() if k != "ok")
+        finally:
+            faults.disarm(f"serve_dispatch.{front.name}.r1")
+        fst = front.status()
+        res["front"] = {
+            "replicas": fst["replicas"],
+            "blocks": nblocks,
+            "lookups_ok": fok,
+            "dropped": fbad,
+            "p99_ms": round(
+                (fst.get("front_block_p99_s") or 0.0) * 1e3, 3),
+            "sheds": fst["front_replica_sheds"],
+            "shed_routes": fst["front_shed_routes"],
+            "staggered_swaps": fst["front_staggered_swaps"],
+        }
+    finally:
+        front.close()
     res["jit"] = _jit_delta(jit0)
     return res
 
@@ -2201,15 +2400,18 @@ SELFTEST_ENV = {
     "BENCH_SERVE_PGS": "2048", "BENCH_SERVE_OSDS": "64",
     "BENCH_SERVE_SECONDS": "5", "BENCH_SERVE_CLIENTS": "2",
     "BENCH_SERVE_BLOCK": "512", "BENCH_SERVE_CHAOS_EPOCHS": "6",
+    "BENCH_SERVE_BULK_SECONDS": "2", "BENCH_SERVE_FRONT_BLOCKS": "10",
+    "BENCH_SERVE_MESH_PGS": "64",
     # fleet stage: the 64-cluster acceptance floor, short lifetimes —
     # the stage pays the solo-oracle loop AND the stacked run
     "BENCH_FLEET_CLUSTERS": "64", "BENCH_FLEET_EPOCHS": "16",
     # generous deadline: the bound comes from the workloads being tiny,
     # not from budget-skipping stages (skips would fail the assert); the
     # 510-epoch lifetime scenario alone is ~200s of real dispatches on a
-    # throttled 2-thread container, and the fleet stage adds a 64x solo
-    # oracle loop plus the stacked run
-    "BENCH_DEADLINE_S": "600", "BENCH_HEADLINE_RESERVE": "20",
+    # throttled 2-thread container, the fleet stage adds a 64x solo
+    # oracle loop plus the stacked run, and the serve bulk/mesh/front
+    # phase adds ~2 minutes (incl. the 2-device meshcheck subprocess)
+    "BENCH_DEADLINE_S": "720", "BENCH_HEADLINE_RESERVE": "20",
     # the survivability path under test: the configured-platform probe
     # hangs; the watchdog kills it in ~2s and the ladder degrades to cpu
     "CEPH_TPU_FAULTS": "init.auto=hang:600",
@@ -2362,6 +2564,12 @@ def _selftest_benchdiff(problems: list[str]) -> dict:
         problems.append(
             "benchdiff did not flag the fleet regression seeded in "
             "the fixture series (schema v12 fleet metrics not folded)")
+    elif not any(d["metric"] == "serve.bulk_qps"
+                 for d in rep["regressions"]):
+        problems.append(
+            "benchdiff did not flag the bulk-edge qps regression "
+            "seeded in the fixture series (schema v13 serve.bulk "
+            "metrics not folded)")
     return {
         "verdict": rep["verdict"],
         "rounds": len(rep["rounds"]),
@@ -2717,6 +2925,60 @@ def selftest() -> int:
             problems.append(
                 "serve chaos applied no background balancing round "
                 "between churn epochs")
+        # bulk-edge acceptance gates (schema v13): the bulk protocol
+        # edge must beat the scalar submit edge >=10x with zero shed
+        # lanes and a compile-free window, and the forced structural
+        # swap mid-window must flip stall-free (prewarmed variants)
+        bk = sv.get("bulk") or {}
+        if not (bk.get("ratio") or 0) >= 10:
+            problems.append(
+                f"serve bulk qps {bk.get('qps')} is not >=10x the "
+                f"scalar submit edge {bk.get('scalar_qps')} "
+                f"(ratio {bk.get('ratio')})")
+        if bk.get("not_ok", -1) != 0:
+            problems.append(
+                f"serve bulk window answered {bk.get('not_ok')} "
+                "non-ok lane(s) (wanted every lane ok)")
+        if bk.get("compiles", -1) != 0:
+            problems.append(
+                f"serve bulk window booked {bk.get('compiles')} "
+                "compile(s) — the forced structural swap is leaking "
+                "traces into the measured window")
+        if not (bk.get("swap_ok") and bk.get("preseed_swap_ok")):
+            problems.append("serve bulk forced structural swap failed")
+        if sv.get("structural_swap_stalls", -1) != 0:
+            problems.append(
+                f"serve bulk window booked "
+                f"{sv.get('structural_swap_stalls')} structural swap "
+                "stall(s) over the flip bound (wanted 0)")
+        # mesh bit-identity gate: 2 forced host devices shard the
+        # serving buffer and the placement digest must not move
+        mh = sv.get("mesh") or {}
+        if mh.get("devices") != 2 or not mh.get("oracle_match_ndev"):
+            problems.append(
+                f"serve mesh subprocess answered on "
+                f"{mh.get('devices')} device(s) "
+                f"(oracle_match={mh.get('oracle_match_ndev')}, "
+                f"error={mh.get('error')})")
+        elif not (mh.get("digest_match")
+                  and mh.get("oracle_match_1dev")):
+            problems.append(
+                "serve mesh placement digest diverged across forced "
+                "device counts (sharded buffer is not bit-identical)")
+        # front gates: the injected one-replica stall must shed that
+        # replica, every lane still answers, and the p99 is recorded
+        fr = sv.get("front") or {}
+        if not fr.get("sheds", 0) >= 1:
+            problems.append(
+                "serve front never shed the stalled replica "
+                f"(sheds={fr.get('sheds')})")
+        if fr.get("dropped", -1) != 0:
+            problems.append(
+                f"serve front answered {fr.get('dropped')} non-ok "
+                "lane(s) under a one-replica stall (wanted 0 — the "
+                "stall is absorbed, not surfaced)")
+        if not (fr.get("p99_ms") or 0) > 0:
+            problems.append("serve front recorded no block p99")
         # device-loop rebalance gates: the whole plan in O(1) XLA
         # dispatches (one per calc_pg_upmaps call), nothing reverted
         # at readback, and the plan bytes deterministic across a
@@ -2802,7 +3064,8 @@ def selftest() -> int:
                      "degraded_answered", "device_loss_recovered",
                      "chaos", "slo", "health", "timeline_samples",
                      "background", "background_round_p99_ms",
-                     "background_query_compiles")
+                     "background_query_compiles", "bulk", "mesh",
+                     "front", "structural_swap_stalls")
         } or None,
         "fleet": {
             k: v for k, v in (out.get("fleet") or {}).items()

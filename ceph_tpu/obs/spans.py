@@ -108,6 +108,12 @@ SPANS: dict[str, str] = {
     "serve.batch": "one micro-batch: deadline triage + device map + "
                    "reply delivery (host syncs allowed: the mapper "
                    "fetches results inside)",
+    "serve.bulk": "one bulk protocol block (query_block/submit_many): "
+                  "pool-grouped lanes, one fixed-shape dispatch per "
+                  "sub-block on the caller's thread",
+    "serve.front": "one bulk block through the multi-replica front: "
+                   "rendezvous-hash routing + per-replica sub-blocks "
+                   "+ reply merge",
     "serve.swap": "epoch-swap staging: clone + incremental apply + "
                   "mapper construction + warm dispatch (off the "
                   "reader path; the flip itself is swap_stall_seconds)",

@@ -20,6 +20,17 @@ Design (see `service.py` for the mechanics):
   framing of "Rateless Codes for Near-Perfect Load Balancing in
   Distributed Matrix-Vector Multiplication" (PAPERS.md): the device
   stays saturated while individual requests carry deadlines.
+- **Bulk protocol edge** — `query_block`/`submit_many` answer
+  thousands of lookups per call on the caller's thread (pool-grouped,
+  cycle-padded once, one dispatch per fixed-shape sub-block) with
+  per-lane statuses; the serving buffer shards its PG axis over the
+  `CEPH_TPU_MESH_DEVICES` mesh exactly like `ClusterState`
+  (bit-identical answers on any device count — `meshcheck.py` is the
+  witness).
+- **Multi-replica front** — `front.ServeFront`: N replicas behind a
+  rendezvous-hash router with staggered epoch fan-out (one replica
+  staging at a time) and slowest-replica shedding, so one replica's
+  swap or stall is absorbed instead of surfacing in client p99.
 - **Double-buffered epoch swaps** — an `osd.incremental` apply stages a
   fresh buffer (map + compiled mappers + refreshed operands) off the
   reader path, then swaps atomically; readers drain on the old buffer.
@@ -39,7 +50,11 @@ live service under seeded client load (`python -m ceph_tpu.cli.serve`).
 
 from __future__ import annotations
 
+from ceph_tpu.serve.front import ServeFront
 from ceph_tpu.serve.service import (
+    REPLY_STATUSES,
+    STATUS_CODES,
+    BulkReply,
     PlacementService,
     Reply,
     ServeConfig,
@@ -47,8 +62,12 @@ from ceph_tpu.serve.service import (
 )
 
 __all__ = [
+    "BulkReply",
     "PlacementService",
     "Reply",
+    "REPLY_STATUSES",
+    "STATUS_CODES",
     "ServeConfig",
+    "ServeFront",
     "status_dump",
 ]

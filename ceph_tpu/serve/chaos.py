@@ -284,6 +284,12 @@ def run_chaos(scenario: str | None = None, epochs: int | None = None,
         # process-wide quantile (phase A's µs-scale flips share it); the
         # u64 tallies are this run's deltas
         "swap_stall_p99_s": st.get("swap_stall_p99_s"),
+        "structural_swap_stalls": delta("structural_swap_stalls"),
+        # micro-batch fill as a distribution, not just the lifetime
+        # average: under-filled windows (the dispatcher outrunning the
+        # producers — the bulk path's failure mode) show at p50/p99
+        "batch_fill_p50": st.get("batch_fill_p50"),
+        "batch_fill_p99": st.get("batch_fill_p99"),
         "degraded_answered": delta("degraded_answered"),
         "queries_shed": delta("queries_shed"),
         "queries_expired": delta("queries_expired"),

@@ -1,0 +1,113 @@
+"""Mesh bit-identity witness for the serving buffer.
+
+The serve buffer shards its PG axis over the `CEPH_TPU_MESH_DEVICES`
+mesh exactly like `ClusterState` (same `NamedSharding`, same
+executables — GSPMD partitions the one compiled pipeline).  The
+contract is that sharding is a THROUGHPUT decision with zero semantic
+surface: answers must be bit-identical on 1, 2 or 8 forced devices,
+and bit-identical to the host-mapper oracle.
+
+Forced CPU devices only exist if `XLA_FLAGS=
+--xla_force_host_platform_device_count=N` is set before jax
+initializes, so the N>1 legs must run in a fresh process.  This module
+is that worker: `python -m ceph_tpu.serve.meshcheck` builds the
+canonical deterministic map, serves every PG of every pool through
+`query_block`, verifies each answer against the host oracle in-process
+and prints one JSON line:
+
+    {"digest": ..., "oracle_match": true, "devices": N, "mesh": {...}}
+
+`placement_digest` is importable, so the parent (a tier-1 test, the
+bench serve stage) computes its own single-device digest in-process
+and compares — equal digests across forced device counts IS the
+bit-identity proof.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+from ceph_tpu.osd.osdmap import OSDMap, build_hierarchical
+from ceph_tpu.osd.types import PgPool, PoolType
+
+# the canonical witness cluster: small enough to stage in seconds,
+# two pools so the digest walks a pool boundary, PG counts divisible
+# by every forced device count the checks use (1/2/8)
+DEFAULT_PGS = 256
+DEFAULT_OSDS = 16
+
+
+def build_default(pgs: int = DEFAULT_PGS,
+                  osds: int = DEFAULT_OSDS) -> OSDMap:
+    pool = PgPool(type=PoolType.REPLICATED, size=3, crush_rule=0,
+                  pg_num=pgs, pgp_num=pgs)
+    m = build_hierarchical(osds // 4, 4, n_rack=1, pool=pool)
+    p2 = PgPool(type=PoolType.REPLICATED, size=2, crush_rule=0,
+                pg_num=pgs // 2, pgp_num=pgs // 2)
+    m.add_pool("meshcheck2", p2)
+    return m
+
+
+def placement_digest(svc, m: OSDMap) -> tuple[str, bool]:
+    """(sha256 digest, oracle_match) over every PG of every pool,
+    answered through the bulk edge.  The digest covers all four row
+    tensors; the oracle check replays each pool through the host
+    mapper at the same padded width."""
+    h = hashlib.sha256()
+    oracle_ok = True
+    for pid in sorted(m.pools):
+        seeds = np.arange(m.pools[pid].pg_num, dtype=np.uint32)
+        r = svc.query_block(pid, seeds, deadline_s=0)
+        if not r.ok:
+            h.update(f"{pid}:notok".encode())
+            oracle_ok = False
+            continue
+        for a in (r.up, r.up_primary, r.acting, r.acting_primary):
+            h.update(np.ascontiguousarray(a).tobytes())
+        up, upp, act, actp = svc._active.host_rows(pid, seeds)
+        oracle_ok = oracle_ok and bool(
+            (r.up == up).all() and (r.up_primary == upp).all()
+            and (r.acting == act).all()
+            and (r.acting_primary == actp).all())
+    return h.hexdigest(), oracle_ok
+
+
+def run(pgs: int = DEFAULT_PGS, osds: int = DEFAULT_OSDS) -> dict:
+    import jax
+
+    from ceph_tpu.serve.service import PlacementService, ServeConfig
+
+    m = build_default(pgs, osds)
+    cfg = ServeConfig(block=128, bulk_max=pgs, max_queue=256,
+                      deadline_s=0)
+    svc = PlacementService(m, config=cfg, name="meshcheck")
+    try:
+        digest, oracle_match = placement_digest(svc, m)
+        st = svc.status()
+        return {
+            "digest": digest,
+            "oracle_match": oracle_match,
+            "devices": len(jax.devices()),
+            "mesh": st["mesh"],
+        }
+    finally:
+        svc.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="serve mesh bit-identity worker")
+    ap.add_argument("--pgs", type=int, default=DEFAULT_PGS)
+    ap.add_argument("--osds", type=int, default=DEFAULT_OSDS)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args.pgs, args.osds)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

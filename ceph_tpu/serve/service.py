@@ -8,11 +8,26 @@ Threading model (all bounded, all join-able):
   block on the request's event with a watchdog timeout — the
   runtime/scheduler idiom: a reply that misses its deadline is abandoned
   by the waiter (late results are discarded, never delivered);
+- the BULK protocol edge (`query_block`/`submit_many`) answers
+  thousands of lookups per call ON the caller's thread: lanes are
+  pool-grouped and cycle-padded once, then one `_PIPE_CACHE` dispatch
+  per fixed-shape sub-block amortizes all dispatcher overhead across
+  the block — no queue, no per-`_Request` Python objects, per-lane
+  statuses instead (never-dropped: every lane gets exactly one).  The
+  scalar `submit()` path stays a thin wrapper over the queued
+  micro-batcher with unchanged EBUSY/ETIMEDOUT/ESHUTDOWN semantics;
 - ONE dispatcher thread drains the queue: collects requests for at most
   `window_s` (or until `fill` queries are pending), groups them by pool,
   pads each pool's seeds to the fixed `block` shape (cycle-pad: one
   compiled executable per structure, exactly the repo-wide trace-once
   contract) and maps them as one device block;
+- ONE warming thread runs structural stagings (`apply` structural
+  epochs, `adopt_map`): any remaining compile happens against the next
+  buffer's fork on that thread, never on a thread that answers
+  queries, and the overlay-structure variants background balancing
+  seeds (pair widths 1/2) are pre-traced at construction — so a
+  structural epoch can never stall readers (`structural_swap_stalls`
+  counts flips that broke the budget; it must stay 0);
 - epoch swaps run on the caller's thread: stage a complete new buffer
   off the reader path, then flip the active reference.  VALUE-ONLY
   epochs (reweights, osd state, overlay values — `osd.state.
@@ -92,6 +107,33 @@ _L.add_u64("swap_full_restages",
            "fresh ClusterState + warm dispatches)")
 _L.add_u64("serve_checkpoints", "epoch+map checkpoints flushed")
 _L.add_avg("batch_fill", "queries per dispatched micro-batch")
+_L.add_quantile("batch_fill_hist",
+                "queries per dispatched micro-batch as a distribution "
+                "(p50/p99 in the dump — under-filled windows, the bulk "
+                "path's failure mode, are invisible in the lifetime "
+                "average the plain batch_fill keeps)",
+                bounds=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                        2048, 4096, 8192, 16384, 32768, 65536])
+_L.add_u64("bulk_blocks",
+           "bulk protocol blocks answered on the caller's thread "
+           "(query_block/submit_many: one fixed-shape dispatch per "
+           "sub-block, no per-request queue objects)")
+_L.add_u64("bulk_lookups",
+           "lookups submitted through the bulk protocol edge (every "
+           "lane, whatever its per-lane status)")
+_L.add_u64("structural_swap_stalls",
+           "structural epoch flips whose reader-visible stall exceeded "
+           "STRUCTURAL_STALL_BOUND_S — the stall-free swap gate (must "
+           "stay 0: pre-traced variants + the warming thread keep "
+           "compiles off the flip)")
+_L.add_u64("prewarmed_structures",
+           "overlay-structure variants pre-traced at service "
+           "construction (the pair widths background balancing seeds) "
+           "so a later structural/overlay-gate epoch stages against a "
+           "warm _PIPE_CACHE")
+_L.add_u64("warm_stages",
+           "structural stagings executed on the warming thread (off "
+           "every thread that answers queries)")
 _L.add_quantile("request_seconds",
                 "submit-to-reply latency per client request (p50/p99 "
                 "in the dump — the serving tail the QPS target is "
@@ -124,6 +166,13 @@ _L.add_quantile("background_round_hist",
                 "clients stay live)")
 
 
+# reader-visible stall budget for a STRUCTURAL epoch flip: the flip is
+# one reference assignment, so anything past this bound means staging
+# leaked work (a compile, a warm dispatch) onto the flip window —
+# counted by `structural_swap_stalls`, gated at 0 by bench and tests
+STRUCTURAL_STALL_BOUND_S = 0.05
+
+
 @dataclass
 class ServeConfig:
     """Service tuning; `from_env` reads the CEPH_TPU_SERVE_* knobs."""
@@ -132,9 +181,13 @@ class ServeConfig:
     block: int = 1024         # fixed dispatch block width (pad-to-shape)
     fill: int = 4096          # stop collecting once this many queries wait
     max_queue: int = 256      # admission bound (pending requests)
-    deadline_s: float = 0.25  # default per-request deadline budget
+    deadline_s: float = 0.25  # default per-request deadline (<=0 disables)
     degraded_batches: int = 16  # host batches before re-trying the device
     checkpoint_every: int = 1   # flush every Nth accepted epoch
+    bulk_max: int = 8192      # bulk sub-block width (pad-to-shape; the
+    #                           one extra dispatch shape warm() pays for)
+    prewarm: bool = True      # pre-trace overlay-structure variants at
+    #                           construction (stall-free first overlay)
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -148,7 +201,31 @@ class ServeConfig:
                 "CEPH_TPU_SERVE_DEADLINE_MS", "250")) / 1e3,
             degraded_batches=int(knobs.get(
                 "CEPH_TPU_SERVE_DEGRADED_BATCHES", "16")),
+            bulk_max=int(knobs.get("CEPH_TPU_SERVE_BULK_MAX", "8192")),
+            prewarm=knobs.get("CEPH_TPU_SERVE_PREWARM", "1") == "1",
         )
+
+
+# reply-status registry — the single authoritative vocabulary of answer
+# codes.  Every `Reply(...)` a dispatcher path constructs and every
+# `STATUS_CODES[...]` lane code the bulk edge writes must name one of
+# these; the graftlint `serve-reply` pass statically matches the call
+# sites in ceph_tpu/serve/ against this dict (and requires each code to
+# be pinned by at least one test literal), so an early-return path
+# cannot invent an undocumented status or silently drop a reply.
+REPLY_STATUSES: dict[str, str] = {
+    "ok": "answered with placement rows (device or degraded host path)",
+    "EBUSY": "shed at admission: queue (or bulk lane capacity) full",
+    "ETIMEDOUT": "deadline budget spent before the reply; late results "
+                 "are discarded, never delivered",
+    "ESHUTDOWN": "service stopped before the reply",
+    "EFAULT": "invalid request (unknown pool, empty batch) or a "
+              "dispatcher error answered loudly",
+}
+
+# dense per-lane codes for the bulk path's status vector ("ok" == 0)
+STATUS_NAMES: tuple[str, ...] = tuple(REPLY_STATUSES)
+STATUS_CODES: dict[str, int] = {s: i for i, s in enumerate(STATUS_NAMES)}
 
 
 @dataclass
@@ -171,6 +248,37 @@ class Reply:
         return self.status == "ok"
 
 
+@dataclass
+class BulkReply:
+    """One bulk block's answer: per-lane status codes + full-width rows.
+
+    `statuses[i]` indexes STATUS_NAMES ("ok" == 0); non-ok lanes carry
+    NONE-padded rows.  The never-dropped contract holds per lane —
+    every submitted lane ends with exactly one status."""
+
+    statuses: np.ndarray                  # [n] uint8 -> STATUS_NAMES
+    epoch: int = 0
+    source: str = ""                 # "device" | "host" | "mixed"
+    up: np.ndarray | None = None          # [n, W] i32, NONE-padded
+    up_primary: np.ndarray | None = None  # [n] i32
+    acting: np.ndarray | None = None      # [n, W] i32
+    acting_primary: np.ndarray | None = None  # [n] i32
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return bool((self.statuses == STATUS_CODES["ok"]).all())
+
+    def counts(self) -> dict[str, int]:
+        """Per-status lane tallies, zero entries elided."""
+        out: dict[str, int] = {}
+        for name, code in STATUS_CODES.items():
+            c = int((self.statuses == code).sum())
+            if c:
+                out[name] = c
+        return out
+
+
 class _Request:
     """One queued lookup batch: (pool, seeds) + deadline + reply slot.
     Exactly ONE reply wins, under the request's own lock: the first
@@ -183,7 +291,8 @@ class _Request:
     __slots__ = ("pool", "seeds", "deadline", "t0", "event", "reply",
                  "abandoned", "_lock")
 
-    def __init__(self, pool: int, seeds: np.ndarray, deadline: float):
+    def __init__(self, pool: int, seeds: np.ndarray,
+                 deadline: float | None):
         self.pool = pool
         self.seeds = seeds
         self.deadline = deadline
@@ -226,11 +335,28 @@ class _Buffer:
     scatter, host crush/pools shared) instead of deepcopying the map
     and re-uploading every table."""
 
-    def __init__(self, m: OSDMap, block: int, state=None):
+    def __init__(self, m: OSDMap, block: int, state=None,
+                 bulk_block: int = 0, mesh=None):
         self.m = m
         self.epoch = m.epoch
         self.block = block
+        self.bulk_block = bulk_block
         self.state = state
+        # the serving buffer resolves CEPH_TPU_MESH_DEVICES exactly
+        # like ClusterState: the state carries its mesh; the stateless
+        # fallback still resolves the knob itself so PG-axis sharding
+        # does not silently drop when ClusterState construction
+        # degrades (provenance stays in last_mesh_provenance either way)
+        if mesh is None:
+            mesh = getattr(state, "mesh", None)
+        if mesh is None and state is None:
+            try:
+                from ceph_tpu.parallel.sharded import default_mesh
+
+                mesh = default_mesh()
+            except Exception:
+                mesh = None
+        self.mesh = mesh
         self._mappers: dict[int, object] = {}
 
     def mapper(self, pool_id: int):
@@ -238,13 +364,15 @@ class _Buffer:
 
         pm = self._mappers.get(pool_id)
         if pm is None:
-            pm = PoolMapper(self.m, pool_id, state=self.state)
+            pm = PoolMapper(self.m, pool_id, state=self.state,
+                            mesh=self.mesh)
             self._mappers[pool_id] = pm
         return pm
 
     def warm_pool(self, pid: int) -> None:
-        """One fixed-shape dispatch for one pool (fast + rescue
-        kernels)."""
+        """One fixed-shape dispatch per served shape for one pool
+        (fast + rescue kernels; the bulk sub-block shape too, so the
+        first query_block never pays a compile)."""
         import jax.numpy as jnp
 
         from ceph_tpu.crush.mapper_jax import RESCUE_PADS
@@ -253,6 +381,9 @@ class _Buffer:
         seeds = (np.arange(self.block) % pm.spec.pg_num).astype(
             np.uint32)
         pm.map_batch(seeds)
+        if self.bulk_block and self.bulk_block != self.block:
+            pm.map_batch((np.arange(self.bulk_block)
+                          % pm.spec.pg_num).astype(np.uint32))
         for p in RESCUE_PADS:
             pad = np.zeros(p, np.intp)
             pm.jitted_loop()(
@@ -336,12 +467,21 @@ class PlacementService:
         self._paused = False
         self._batch_seq = 0
         self._degraded_left = 0
+        self._bulk_inflight = 0  # lanes inside query_block calls
         self.fallback_events: list[str] = []
         self._swaps_since_ck = 0
         self.slo = SloEngine()
         self._slo_prev: dict = {}  # counter snapshot at last window sample
         self._slo_t = 0.0
+        # structural stagings run on this thread once the service is
+        # live (constructed lazily by _stage_async); the initial stage
+        # and the variant prewarm run here, before anything serves
+        self._warm_cv = threading.Condition()
+        self._warm_jobs: deque = deque()
+        self._warmer: threading.Thread | None = None
+        self._prewarmed: set[tuple] = set()
         self._active = self._stage(m)
+        self._prewarm_structures(self._active)
         self._checkpoint()
         self._thread = threading.Thread(
             target=self._loop, name=f"ceph-tpu-{name}", daemon=True)
@@ -366,8 +506,13 @@ class PlacementService:
                          error="empty seed batch")
         deadline_s = self.config.deadline_s if deadline_s is None \
             else deadline_s
-        now = time.perf_counter()
-        req = _Request(pool, seeds, now + deadline_s)
+        # deadline_s <= 0 disables deadline bookkeeping entirely: no
+        # per-request absolute deadline, no expiry triage in the
+        # dispatcher, an unbounded reply wait (shutdown still answers)
+        timed = deadline_s > 0
+        req = _Request(pool, seeds,
+                       time.perf_counter() + deadline_s if timed
+                       else None)
         with self._q_cv:
             if self._stop:
                 return Reply("ESHUTDOWN", epoch=self.epoch,
@@ -382,7 +527,8 @@ class PlacementService:
             self._q_cv.notify()
         # watchdogged wait (runtime/scheduler idiom): a margin past the
         # deadline covers the in-flight dispatch that may still answer
-        if not req.event.wait(deadline_s + 0.25) and req.abandon():
+        if not req.event.wait(deadline_s + 0.25 if timed else None) \
+                and req.abandon():
             _L.inc("queries_expired", len(seeds))
             return Reply("ETIMEDOUT", epoch=self.epoch,
                          error=f"no reply within {deadline_s:.3f}s")
@@ -404,6 +550,227 @@ class PlacementService:
         ps = p.hash_key(key, ns)
         seed = int(stable_mod(ps, p.pg_num, pg_mask_for(p.pg_num)))
         return self.lookup(pool, seed, deadline_s)
+
+    def submit(self, pool: int, seed: int,
+               deadline_s: float | None = None) -> Reply:
+        """Scalar protocol edge: a thin wrapper over the queued
+        micro-batcher, EBUSY/ETIMEDOUT/ESHUTDOWN semantics unchanged
+        (the bulk edge below is where the throughput lives)."""
+        return self.lookup_batch(pool, [seed], deadline_s)
+
+    # -- the bulk protocol edge --------------------------------------------
+
+    def _bulk_admit(self, n: int) -> int:
+        """Grant up to `n` bulk lanes against the lane-capacity bound
+        (`max_queue * block` lanes in flight across concurrent bulk
+        calls — the same admission philosophy as the request queue,
+        counted in lookups instead of requests).  Lanes beyond the
+        grant shed EBUSY per-lane; the caller must release the grant."""
+        cap = self.config.max_queue * self.config.block
+        with self._q_lock:
+            granted = max(0, min(n, cap - self._bulk_inflight))
+            self._bulk_inflight += granted
+        return granted
+
+    def _bulk_release(self, granted: int) -> None:
+        with self._q_lock:
+            self._bulk_inflight -= granted
+
+    def _bulk_rows(self, buf: _Buffer, pool: int, padded: np.ndarray,
+                   n_real: int):
+        """One bulk sub-block through ONE fixed-shape dispatch, with
+        the same degraded-host ladder as the micro-batcher.  The fault
+        qualifier is the SERVICE name, so a front can aim a stall at
+        one replica (`serve_dispatch.<name>`) while the bare point
+        still hits every dispatch."""
+        if self._degraded_left > 0:
+            self._degraded_left -= 1
+            _L.inc("degraded_answered", n_real)
+            return buf.host_rows(pool, padded[:n_real]), "host"
+        try:
+            faults.check("serve_dispatch", qual=self.name)
+            pm = buf.mapper(pool)
+            rows = pm.map_batch(padded)
+            rows = tuple(o[:n_real] for o in rows)
+            if self.fallback_events and not self._recovered_logged():
+                _L.inc("device_recoveries")
+                obs.instant("serve.recovered", pool=pool)
+                self.fallback_events.append(
+                    "recovered: device dispatch healthy again")
+            return rows, "device"
+        except Exception as e:
+            if not faults.looks_like_device_loss(e):
+                raise
+            self._degraded_left = self.config.degraded_batches
+            msg = (f"epoch {buf.epoch} pool {pool}: "
+                   f"{type(e).__name__}: {e}"[:200] + " -> host mapper")
+            self.fallback_events.append(msg)
+            obs.instant("serve.degraded", pool=pool)
+            _log(1, f"device lost mid-serve (bulk); {msg}")
+            _L.inc("degraded_answered", n_real)
+            return buf.host_rows(pool, padded[:n_real]), "host"
+
+    def query_block(self, pool: int, seeds,
+                    deadline_s: float | None = None) -> BulkReply:
+        """Bulk protocol edge: answer thousands of lookups of ONE pool
+        in one call.  Lanes are cycle-padded once to a fixed sub-block
+        shape and each sub-block is ONE `_PIPE_CACHE` dispatch — the
+        per-request Python cost of the queued path (request objects,
+        events, the dispatcher handoff) is amortized across the whole
+        block.  Runs on the CALLER's thread; the micro-batcher keeps
+        serving scalar traffic beside it.  Per-lane statuses keep the
+        never-dropped contract: over-capacity lanes shed EBUSY, lanes
+        past the deadline answer ETIMEDOUT, every lane gets exactly
+        one status."""
+        seeds = np.ascontiguousarray(
+            np.asarray(seeds, np.uint32).ravel())
+        n = len(seeds)
+        if n == 0:
+            return BulkReply(np.zeros(0, np.uint8), epoch=self.epoch)
+        t0 = time.perf_counter()
+        if self._stop:
+            return BulkReply(
+                np.full(n, STATUS_CODES["ESHUTDOWN"], np.uint8),
+                epoch=self.epoch, error="service stopped")
+        buf = self._active  # captured once: swaps flip under us safely
+        if pool not in buf.m.pools:
+            return BulkReply(
+                np.full(n, STATUS_CODES["EFAULT"], np.uint8),
+                epoch=buf.epoch, error=f"no pool {pool}")
+        deadline_s = self.config.deadline_s if deadline_s is None \
+            else deadline_s
+        deadline = t0 + deadline_s if deadline_s > 0 else None
+        granted = self._bulk_admit(n)
+        statuses = np.zeros(n, np.uint8)
+        error = ""
+        if granted < n:
+            statuses[granted:] = STATUS_CODES["EBUSY"]
+            _L.inc("queries_shed", n - granted)
+            error = "bulk lane capacity full"
+        pm = buf.mapper(pool)
+        W = pm.spec.out_width
+        up = np.full((n, W), ITEM_NONE, np.int32)
+        upp = np.full(n, -1, np.int32)
+        act = np.full((n, W), ITEM_NONE, np.int32)
+        actp = np.full(n, -1, np.int32)
+        cfg = self.config
+        bmax = max(cfg.bulk_max, cfg.block)
+        sources: set[str] = set()
+        done = 0
+        try:
+            with obs.span("serve.bulk", lookups=n, pool=pool):
+                while done < granted:
+                    if deadline is not None and \
+                            time.perf_counter() > deadline:
+                        statuses[done:granted] = \
+                            STATUS_CODES["ETIMEDOUT"]
+                        _L.inc("queries_expired", granted - done)
+                        error = error or \
+                            f"deadline spent after {done} lanes"
+                        break
+                    take = min(bmax, granted - done)
+                    # two warmed shapes only: the scalar block and the
+                    # bulk sub-block (warm_pool paid both off-path)
+                    shape = cfg.block if take <= cfg.block else bmax
+                    blk = seeds[done:done + take]
+                    rows, src = self._bulk_rows(
+                        buf, pool, np.resize(blk, shape), take)
+                    u, u_p, a, a_p = rows
+                    up[done:done + take] = u
+                    upp[done:done + take] = u_p
+                    act[done:done + take] = a
+                    actp[done:done + take] = a_p
+                    sources.add(src)
+                    done += take
+        except Exception as e:
+            # a dispatcher bug must not eat lanes: the remainder of the
+            # grant answers EFAULT loudly, the shed/done lanes keep
+            # their statuses
+            statuses[done:granted] = STATUS_CODES["EFAULT"]
+            error = f"{type(e).__name__}: {e}"[:200]
+            _log(0, f"bulk dispatch error: {error}")
+        finally:
+            self._bulk_release(granted)
+        if done:
+            _L.inc("queries", done)
+        _L.inc("bulk_blocks")
+        _L.inc("bulk_lookups", n)
+        _L.observe("request_seconds", time.perf_counter() - t0)
+        source = sources.pop() if len(sources) == 1 else (
+            "mixed" if sources else "")
+        return BulkReply(statuses, epoch=buf.epoch, source=source,
+                         up=up, up_primary=upp, acting=act,
+                         acting_primary=actp, error=error)
+
+    def submit_many(self, pools, seeds,
+                    deadline_s: float | None = None) -> BulkReply:
+        """Mixed-pool bulk submit: ONE stable argsort groups the lanes
+        by pool, each group goes through `query_block`, and the replies
+        scatter back to input order.  `pools` may be a scalar (pure
+        single-pool fast path) or a per-lane array."""
+        seeds = np.asarray(seeds, np.uint32).ravel()
+        pools_a = np.asarray(pools, np.int64).ravel()
+        if pools_a.size == 1:
+            return self.query_block(int(pools_a[0]), seeds, deadline_s)
+        if pools_a.shape != seeds.shape:
+            return BulkReply(
+                np.full(len(seeds), STATUS_CODES["EFAULT"], np.uint8),
+                epoch=self.epoch, error="pools/seeds length mismatch")
+        n = len(seeds)
+        if n == 0:
+            return BulkReply(np.zeros(0, np.uint8), epoch=self.epoch)
+        deadline_s = self.config.deadline_s if deadline_s is None \
+            else deadline_s
+        t_end = time.perf_counter() + deadline_s if deadline_s > 0 \
+            else None
+        order = np.argsort(pools_a, kind="stable")
+        sorted_pools = pools_a[order]
+        cuts = np.flatnonzero(np.diff(sorted_pools)) + 1
+        groups = np.split(order, cuts)
+        replies: list[tuple[np.ndarray, BulkReply]] = []
+        for idx in groups:
+            left = (t_end - time.perf_counter()) if t_end is not None \
+                else 0.0
+            if t_end is not None and left <= 0:
+                r = BulkReply(
+                    np.full(len(idx), STATUS_CODES["ETIMEDOUT"],
+                            np.uint8),
+                    epoch=self.epoch, error="deadline spent")
+            else:
+                # the remaining absolute budget is shared across the
+                # pool groups (0 = bookkeeping disabled end to end)
+                r = self.query_block(int(pools_a[idx[0]]), seeds[idx],
+                                     left)
+            replies.append((idx, r))
+        W = max((r.up.shape[1] for _, r in replies
+                 if r.up is not None), default=0)
+        statuses = np.zeros(n, np.uint8)
+        up = np.full((n, W), ITEM_NONE, np.int32)
+        upp = np.full(n, -1, np.int32)
+        act = np.full((n, W), ITEM_NONE, np.int32)
+        actp = np.full(n, -1, np.int32)
+        sources: set[str] = set()
+        errors: list[str] = []
+        epoch = self.epoch
+        for idx, r in replies:
+            statuses[idx] = r.statuses
+            if r.up is not None:
+                w = r.up.shape[1]
+                up[idx, :w] = r.up
+                upp[idx] = r.up_primary
+                act[idx, :w] = r.acting
+                actp[idx] = r.acting_primary
+            if r.source:
+                sources.add(r.source)
+            if r.error:
+                errors.append(r.error)
+            epoch = max(epoch, r.epoch)
+        source = sources.pop() if len(sources) == 1 else (
+            "mixed" if sources else "")
+        return BulkReply(statuses, epoch=epoch, source=source,
+                         up=up, up_primary=upp, acting=act,
+                         acting_primary=actp,
+                         error="; ".join(errors)[:200])
 
     # -- epoch swaps -------------------------------------------------------
 
@@ -428,13 +795,18 @@ class PlacementService:
                     classified = (classify_incremental(inc, old.m)
                                   if old.state is not None else
                                   ("rebuild", None))
-                    if classified[0] == "delta":
+                    structural = classified[0] != "delta"
+                    if not structural:
                         buf = self._stage_value(old, inc, classified)
                         _L.inc("swap_delta_applies")
                     else:
+                        # structural: any remaining compile runs on the
+                        # warming thread against the next buffer's fork
+                        # (never on a thread that answers queries)
                         m2 = copy.deepcopy(old.m)
                         m2 = apply_incremental(m2, inc)
-                        buf = self._stage(m2)
+                        buf = self._stage_async(
+                            lambda: self._stage(m2))
                         _L.inc("swap_full_restages")
             except Exception as e:
                 _L.inc("swap_rejected")
@@ -443,7 +815,7 @@ class PlacementService:
                         f"{old.epoch} keeps serving")
                 return {"ok": False, "epoch": old.epoch,
                         "error": f"{type(e).__name__}: {e}"[:200]}
-            return self._flip(buf)
+            return self._flip(buf, structural=structural)
 
     def adopt_map(self, m: OSDMap, reason: str = "") -> dict:
         """Swap to a complete map (the chaos harness hands the lifetime
@@ -457,7 +829,8 @@ class PlacementService:
                 faults.check("epoch_swap", qual=str(m.epoch))
                 with obs.span("serve.swap", epoch=m.epoch), \
                         _L.time("swap_prepare_seconds"):
-                    buf = self._stage(copy.deepcopy(m))
+                    m2 = copy.deepcopy(m)
+                    buf = self._stage_async(lambda: self._stage(m2))
             except Exception as e:
                 _L.inc("swap_rejected")
                 _log(1, f"epoch swap to {m.epoch} rejected "
@@ -465,7 +838,7 @@ class PlacementService:
                         f"{old.epoch} keeps serving ({reason})")
                 return {"ok": False, "epoch": old.epoch,
                         "error": f"{type(e).__name__}: {e}"[:200]}
-            return self._flip(buf)
+            return self._flip(buf, structural=True)
 
     def _stage(self, m: OSDMap) -> _Buffer:
         """Full staging: fresh ClusterState (device arrays/tables/
@@ -482,7 +855,9 @@ class PlacementService:
             # per-mapper way
             _log(1, f"serve staging without ClusterState "
                     f"({type(e).__name__}: {e})")
-        buf = _Buffer(m, self.config.block, state=state)
+        buf = _Buffer(m, self.config.block, state=state,
+                      bulk_block=max(self.config.bulk_max,
+                                     self.config.block))
         buf.warm()
         return buf
 
@@ -494,7 +869,8 @@ class PlacementService:
         flipping on) — a plain reweight epoch stages with zero mapping
         dispatches and zero full-table device_puts."""
         st2 = old.state.fork(inc, _classified=classified)
-        buf = _Buffer(st2.m, self.config.block, state=st2)
+        buf = _Buffer(st2.m, self.config.block, state=st2,
+                      bulk_block=old.bulk_block)
         for pid in sorted(st2.m.pools):
             pm_old = old._mappers.get(pid)
             if pm_old is None or \
@@ -502,7 +878,83 @@ class PlacementService:
                 buf.warm_pool(pid)
         return buf
 
-    def _flip(self, buf: _Buffer) -> dict:
+    def _warm_loop(self) -> None:
+        while True:
+            with self._warm_cv:
+                while not self._warm_jobs and not self._stop:
+                    self._warm_cv.wait(timeout=0.1)
+                if self._stop and not self._warm_jobs:
+                    return
+                fn, done, slot = self._warm_jobs.popleft()
+            try:
+                slot["result"] = fn()
+                _L.inc("warm_stages")
+            except BaseException as e:  # staging errors travel back to
+                slot["error"] = e       # the applier, never kill the loop
+            done.set()
+
+    def _stage_async(self, fn):
+        """Run one staging job on the warming thread; the caller (the
+        applier, under `_apply_lock`) blocks for the result.  Keeps
+        structural compiles off every thread that answers queries —
+        the GIL-visible stall of a trace never lands between a reader's
+        dispatch and its reply.  Falls back inline when the warmer is
+        unavailable (shutdown, or the warmer itself staging)."""
+        if self._warmer is None or not self._warmer.is_alive():
+            if self._stop:
+                return fn()
+            self._warmer = threading.Thread(
+                target=self._warm_loop,
+                name=f"ceph-tpu-{self.name}-warm", daemon=True)
+            self._warmer.start()
+        if threading.current_thread() is self._warmer:
+            return fn()
+        done = threading.Event()
+        slot: dict = {}
+        with self._warm_cv:
+            self._warm_jobs.append((fn, done, slot))
+            self._warm_cv.notify()
+        done.wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
+
+    def _prewarm_structures(self, buf: _Buffer) -> None:
+        """Pre-trace the overlay-structure variants background
+        balancing seeds: upmap pair widths 1 and 2 flip the pipeline's
+        `n_upmap_pairs` structural gate, so the FIRST overlay epoch
+        after construction would otherwise compile while the service
+        is live.  A value-copied map with synthetic pairs on one PG
+        mints the same `_PIPE_CACHE` entries here, at construction,
+        off every measured window (the cache is process-global and
+        keyed on structure, not map values)."""
+        if not self.config.prewarm:
+            return
+        from ceph_tpu.osd.state import value_copy_map
+
+        for pid in sorted(buf.m.pools):
+            pm = buf.mapper(pid)
+            have = pm.ov.n_pairs
+            for k in (1, 2):
+                key = (pid, pm.cache_key, k)
+                if k == have or key in self._prewarmed:
+                    continue
+                self._prewarmed.add(key)
+                try:
+                    m2 = value_copy_map(buf.m)
+                    m2.pg_upmap_items = dict(m2.pg_upmap_items)
+                    m2.pg_upmap_items[PgId(pid, 0)] = [
+                        (j, j) for j in range(k)]
+                    vb = _Buffer(m2, self.config.block,
+                                 bulk_block=buf.bulk_block,
+                                 mesh=buf.mesh)
+                    vb.warm_pool(pid)
+                    _L.inc("prewarmed_structures")
+                except Exception as e:
+                    _log(1, f"structure prewarm pool {pid} pairs={k} "
+                            f"failed ({type(e).__name__}: {e})")
+
+    def _flip(self, buf: _Buffer, structural: bool = False) -> dict:
         # the only reader-visible window of a swap: one reference
         # assignment.  Readers that already captured the old buffer
         # drain on it; the quantile records the bound the bench gates.
@@ -510,6 +962,11 @@ class PlacementService:
         self._active = buf
         stall = time.perf_counter() - t0
         _L.observe("swap_stall_seconds", stall)
+        if structural and stall > STRUCTURAL_STALL_BOUND_S:
+            # the stall-free-structural-swap gate: staging (and any
+            # compile) already happened off-path, so a flip that still
+            # broke the budget is a contract violation worth counting
+            _L.inc("structural_swap_stalls")
         _L.inc("epoch_swaps")
         obs.instant("serve.swap_applied", epoch=buf.epoch)
         self._swaps_since_ck += 1
@@ -598,7 +1055,13 @@ class PlacementService:
             self._q_cv.notify()
 
     def _collect(self) -> list[_Request]:
-        """Block for work, then gather up to `window_s` / `fill`."""
+        """Block for work, then gather up to `window_s` / `fill`.
+
+        The window clock is hoisted OUT of the per-request loop:
+        already-queued requests drain with zero clock reads, and the
+        clock is read once per wait cycle (only when the queue runs
+        dry before `fill`) — at 1M lookups/s the per-request
+        perf_counter() call was itself a measurable dispatcher tax."""
         cfg = self.config
         with self._q_cv:
             while not self._stop and (not self._q or self._paused):
@@ -606,19 +1069,23 @@ class PlacementService:
             if self._stop:
                 return []
             batch = [self._q.popleft()]
-            t_end = time.perf_counter() + cfg.window_s
             n = len(batch[0].seeds)
+            t_end = None  # window starts at the first dry wait
             while n < cfg.fill:
-                left = t_end - time.perf_counter()
+                if self._q:
+                    req = self._q.popleft()
+                    batch.append(req)
+                    n += len(req.seeds)
+                    continue
+                now = time.perf_counter()
+                if t_end is None:
+                    t_end = now + cfg.window_s
+                left = t_end - now
                 if left <= 0:
                     break
+                self._q_cv.wait(timeout=left)
                 if not self._q:
-                    self._q_cv.wait(timeout=left)
-                    if not self._q:
-                        break
-                req = self._q.popleft()
-                batch.append(req)
-                n += len(req.seeds)
+                    break
         return batch
 
     def _loop(self) -> None:
@@ -702,7 +1169,7 @@ class PlacementService:
         for req in batch:
             if req.abandoned:
                 continue
-            if now > req.deadline:
+            if req.deadline is not None and now > req.deadline:
                 if req.answer(Reply(
                         "ETIMEDOUT", epoch=buf.epoch,
                         error="deadline budget spent in the queue")):
@@ -718,6 +1185,7 @@ class PlacementService:
             return
         _L.inc("batches")
         _L.observe("batch_fill", n_live)
+        _L.observe("batch_fill_hist", n_live)
         with obs.span("serve.batch", queries=n_live, pools=len(live)):
             for pool, reqs in live.items():
                 seeds = np.concatenate([r.seeds for r in reqs])
@@ -835,7 +1303,15 @@ class PlacementService:
         d = _L.dump()
         stall = d.get("swap_stall_seconds") or {}
         req = d.get("request_seconds") or {}
+        fill = d.get("batch_fill_hist") or {}
         wl = obs.perf_dump().get("workload") or {}
+        try:
+            from ceph_tpu.parallel.sharded import last_mesh_provenance
+
+            mesh_prov = last_mesh_provenance()
+        except Exception:
+            mesh_prov = {}
+        mesh = self._active.mesh
         out = {
             "epoch": self.epoch,
             "pools": sorted(self._active.m.pools),
@@ -853,8 +1329,21 @@ class PlacementService:
             "swap_delta_applies": d.get("swap_delta_applies", 0),
             "swap_full_restages": d.get("swap_full_restages", 0),
             "swap_stall_p99_s": stall.get("p99"),
+            "structural_swap_stalls": d.get("structural_swap_stalls", 0),
+            "prewarmed_structures": d.get("prewarmed_structures", 0),
+            "bulk_blocks": d.get("bulk_blocks", 0),
+            "bulk_lookups": d.get("bulk_lookups", 0),
             "request_p50_s": req.get("p50"),
             "request_p99_s": req.get("p99"),
+            "batch_fill_p50": fill.get("p50"),
+            "batch_fill_p99": fill.get("p99"),
+            # mesh provenance: the serving buffer shards its PG axis
+            # exactly like ClusterState (CEPH_TPU_MESH_DEVICES)
+            "mesh": {
+                "devices": int(mesh.devices.size) if mesh is not None
+                else 1,
+                "provenance": mesh_prov,
+            },
             "health": obs.health.status(),
             "slo": self.slo.status(),
             # the client-visible story the lifetime workload model
@@ -871,6 +1360,7 @@ class PlacementService:
                 "fill": self.config.fill,
                 "max_queue": self.config.max_queue,
                 "deadline_s": self.config.deadline_s,
+                "bulk_max": self.config.bulk_max,
             },
         }
         if self.resumed_from is not None:
@@ -882,7 +1372,11 @@ class PlacementService:
         with self._q_cv:
             self._stop = True
             self._q_cv.notify_all()
+        with self._warm_cv:
+            self._warm_cv.notify_all()
         self._thread.join(timeout=10)
+        if self._warmer is not None:
+            self._warmer.join(timeout=10)
         self._checkpoint()
         with _services_lock:
             if _SERVICES.get(self.name) is self:

@@ -141,6 +141,10 @@ def test_seeded_regressions_flagged():
         "fleet.digest_matches",                # 64 -> 49
         "fleet.steady_compiles",               # 0 -> 5: zero baseline
         "fleet.pareto_front_size",             # 3 -> 0
+        # bulk protocol edge (v13, seeded in r23->r24): the
+        # amortization ratio is a same-stage quotient — dimensionless,
+        # compared raw (the qps itself flags normalized below)
+        "serve.bulk_ratio",                    # 69.4 -> 6.4
     }
     assert structural | {
         "configs.headline.mappings_per_sec",   # throughput -47%
@@ -155,6 +159,7 @@ def test_seeded_regressions_flagged():
         # batching went inert — back to ~1 dispatch per change; same
         # calibration, so it flags as a same-machine semantic slowdown
         "balancer.dispatches_per_change",      # 0.1875 -> 1.0625
+        "serve.bulk_qps",                      # bulk edge -91%
     } <= flagged
     # every flagged throughput/tail metric compared on the same-machine
     # calibration basis, not raw cross-container numbers
@@ -385,6 +390,44 @@ def test_fleet_fixture_pair_v12():
     rep2 = diff_series([by["r20"], by["r21"]])
     assert not any(d["metric"].startswith("fleet.")
                    for d in rep2["regressions"])
+
+
+def test_bulk_fixture_pair_v13():
+    """The v13 seeded pair in isolation: the healthy bulk-edge round
+    (r23: bulk 10^2x over the scalar submit edge, 0 compiles, 0
+    structural stalls, mesh digests matching, the front shedding its
+    stalled replica with nothing dropped) against the regression (r24:
+    the bulk edge collapsed ~10x).  The qps flags normalized (same
+    calibration: a same-machine semantic slowdown); the ratio — the
+    amortization headline — flags raw."""
+    by = {r.name: r for r in fixture_rounds()}
+    rep = diff_series([by["r23"], by["r24"]])
+    assert rep["verdict"] == "regression"
+    flagged = {d["metric"]: d for d in rep["regressions"]}
+    assert "serve.bulk_qps" in flagged
+    assert flagged["serve.bulk_qps"]["normalized"]
+    assert flagged["serve.bulk_qps"]["prev"] == 125000.0
+    assert flagged["serve.bulk_qps"]["cur"] == 11500.0
+    assert "serve.bulk_ratio" in flagged
+    assert not flagged["serve.bulk_ratio"]["normalized"]  # raw
+    # the healthy record alone extracts the full v13 shape
+    m = extract_metrics(by["r23"].record)
+    assert m["serve.bulk_qps"] == (125000.0, True, True)
+    assert m["serve.bulk_ratio"] == (69.4, True, False)
+    assert m["serve.bulk_compiles"] == (0.0, False, False)
+    assert m["serve.structural_swap_stalls"] == (0.0, False, False)
+    assert m["serve.mesh_devices"] == (2.0, True, False)
+    assert m["serve.mesh_digest_match"] == (1.0, True, False)
+    assert m["serve.front_p99_ms"] == (45.0, False, True)
+    assert m["serve.front_sheds"] == (1.0, True, False)
+    # the healthy direction (r22 fleet regression recovering into r23)
+    # never flags a bulk/mesh/front metric
+    rep2 = diff_series([by["r22"], by["r23"]])
+    assert not any(
+        d["metric"].startswith(("serve.bulk", "serve.mesh",
+                                "serve.front",
+                                "serve.structural_swap_stalls"))
+        for d in rep2["regressions"])
 
 
 def test_healthy_calibrated_rounds_are_clean():

@@ -664,6 +664,56 @@ def test_fault_registry_covers_compiled_in_points():
     }
 
 
+# -- serve-reply ------------------------------------------------------------
+
+def test_serve_reply_fires_on_undeclared_and_dropped(tmp_path):
+    v = lint(tmp_path, (
+        "def answer(bad, worse) -> Reply:\n"
+        "    if bad:\n"
+        "        return Reply('EWEIRD', error='x')\n"   # undeclared
+        "    if worse:\n"
+        "        return\n"                              # dropped reply
+        "    lanes = STATUS_CODES['ENOPE']\n"           # undeclared code
+        "    return Reply('ok')\n"
+    ), "serve-reply", name="serve_fixture.py")
+    assert sorted(x.line for x in v) == [3, 5, 6]
+    msgs = " | ".join(x.message for x in v)
+    assert "EWEIRD" in msgs and "not declared" in msgs
+    assert "ENOPE" in msgs
+    assert "dropped reply" in msgs
+
+
+def test_serve_reply_clean_on_declared_statuses(tmp_path):
+    v = lint(tmp_path, (
+        "def answer(n) -> Reply:\n"
+        "    if n:\n"
+        "        return Reply('EBUSY', error='full')\n"
+        "    lanes = STATUS_CODES['ETIMEDOUT']\n"
+        "    def fill():\n"
+        "        return\n"       # nested, un-annotated: not a reply path
+        "    fill()\n"
+        "    return Reply('ok')\n"
+        "def helper():\n"
+        "    return\n"           # un-annotated: not a reply path
+    ), "serve-reply", name="serve_fixture.py")
+    assert v == []
+
+
+def test_serve_reply_flags_untested_declared_status():
+    status = "EZZ_" + "UNSEEN"
+    ctx = Context(paths=[])  # parses tests/, no scanned modules
+    ctx.reply_statuses = dict(ctx.reply_statuses, **{status: "never"})
+    ctx.reply_lines = dict(ctx.reply_lines, **{status: 1})
+    PASSES["serve-reply"].run(ctx)
+    assert len(ctx.violations) == 1, ctx.violations
+    v = ctx.violations[0]
+    assert status in v.message and "no test" in v.message
+    assert v.path == "ceph_tpu/serve/service.py"
+    # the real vocabulary is fully pinned by the suite
+    assert not any(s in v.message for s in
+                   ("'ok'", "'EBUSY'", "'ETIMEDOUT'", "'ESHUTDOWN'"))
+
+
 # -- runner + reporters -----------------------------------------------------
 
 def test_run_unknown_pass_raises():

@@ -42,7 +42,7 @@ def _map():
 
 def _cfg(**kw):
     base = dict(window_s=0.02, block=64, fill=512, max_queue=8,
-                deadline_s=5.0, degraded_batches=1)
+                deadline_s=5.0, degraded_batches=1, bulk_max=256)
     base.update(kw)
     return ServeConfig(**base)
 
@@ -50,6 +50,19 @@ def _cfg(**kw):
 @pytest.fixture(scope="module")
 def svc():
     s = PlacementService(_map(), config=_cfg(), name="test.serve")
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def svc2():
+    """Two-pool service (the meshcheck witness map at small size) for
+    the mixed-pool bulk tests; prewarm off — the overlay variants are
+    already exercised through the main fixture."""
+    from ceph_tpu.serve.meshcheck import build_default
+
+    s = PlacementService(build_default(pgs=64, osds=8),
+                         config=_cfg(prewarm=False), name="test.serve2")
     yield s
     s.close()
 
@@ -311,6 +324,247 @@ def test_resume_without_state_raises(tmp_path):
         PlacementService(config=_cfg(),
                          checkpoint=str(tmp_path / "empty.json"),
                          resume=True)
+
+
+# -- bulk protocol edge -----------------------------------------------------
+
+def test_query_block_matches_host_oracle(svc):
+    rng = np.random.default_rng(7)
+    seeds = rng.integers(0, N_PGS, 500).astype(np.uint32)
+    r = svc.query_block(0, seeds)
+    assert r.ok and r.source == "device" and r.epoch == svc.epoch
+    up, upp, act, actp = _oracle_rows(svc._active.m, 0, seeds,
+                                      r.up.shape[1])
+    assert np.array_equal(r.up, up)
+    assert np.array_equal(r.up_primary, upp)
+    assert np.array_equal(r.acting, act)
+    assert np.array_equal(r.acting_primary, actp)
+    # the scalar edge is a thin wrapper over the same answers
+    for i in (0, 137, 499):
+        s = svc.submit(0, int(seeds[i]))
+        assert s.ok
+        assert np.array_equal(s.acting[0], r.acting[i])
+        assert int(s.acting_primary[0]) == int(r.acting_primary[i])
+
+
+def test_bulk_partial_shed_answers_every_lane(svc):
+    """An oversized block sheds the over-capacity tail EBUSY per-lane
+    — the granted lanes still answer with correct rows and every lane
+    carries exactly one status (dropped == 0 by construction)."""
+    cap = svc.config.max_queue * svc.config.block  # bulk lane bound
+    n = cap + 488
+    seeds = (np.arange(n, dtype=np.uint32) * 3) % N_PGS
+    r = svc.query_block(0, seeds)
+    c = r.counts()
+    assert c == {"ok": cap, "EBUSY": n - cap}
+    assert sum(c.values()) == n  # nothing dropped
+    assert "capacity" in r.error
+    up, upp, act, actp = _oracle_rows(svc._active.m, 0, seeds[:cap],
+                                      r.up.shape[1])
+    assert np.array_equal(r.acting[:cap], act)
+    assert np.array_equal(r.acting_primary[:cap], actp)
+    # shed lanes carry NONE-padded rows, not stale answers
+    assert (r.acting[cap:] == ITEM_NONE).all()
+    assert (r.acting_primary[cap:] == -1).all()
+
+
+def test_bulk_deadline_expiry_answers_etimedout_remainder(svc):
+    """A stalled first sub-block spends the deadline; the remaining
+    lanes answer ETIMEDOUT instead of blocking or vanishing."""
+    sub = max(svc.config.bulk_max, svc.config.block)
+    seeds = np.arange(2 * sub, dtype=np.uint32) % N_PGS
+    faults.arm("serve_dispatch.test.serve", "stall", "0.5", 1)
+    try:
+        r = svc.query_block(0, seeds, deadline_s=0.25)
+    finally:
+        faults.disarm("serve_dispatch.test.serve")
+    assert r.counts() == {"ok": sub, "ETIMEDOUT": sub}
+    assert "deadline" in r.error
+    up, upp, act, actp = _oracle_rows(svc._active.m, 0, seeds[:sub],
+                                      r.up.shape[1])
+    assert np.array_equal(r.acting[:sub], act)
+    assert np.array_equal(r.acting_primary[:sub], actp)
+
+
+def test_bulk_and_scalar_interleave_equivalence(svc):
+    """Caller-thread bulk blocks beside queued scalar traffic: both
+    paths answer the host-mapper oracle bit-exactly while interleaved."""
+    rng = np.random.default_rng(11)
+    scalar_out: list = []
+    stop = threading.Event()
+
+    def scalar_client():
+        while not stop.is_set():
+            s = int(rng.integers(0, N_PGS))
+            scalar_out.append((s, svc.submit(0, s)))
+
+    t = threading.Thread(target=scalar_client)
+    t.start()
+    try:
+        m = svc._active.m
+        for _ in range(5):
+            seeds = rng.integers(0, N_PGS, 300).astype(np.uint32)
+            r = svc.query_block(0, seeds)
+            assert r.ok
+            _, _, act, actp = _oracle_rows(m, 0, seeds, r.up.shape[1])
+            assert np.array_equal(r.acting, act)
+            assert np.array_equal(r.acting_primary, actp)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert scalar_out and all(rep.ok for _, rep in scalar_out)
+    m = svc._active.m
+    for s, rep in scalar_out[:20]:
+        _, _, act, actp = _oracle_rows(m, 0, np.asarray([s]),
+                                       rep.acting.shape[1])
+        assert np.array_equal(rep.acting, act)
+        assert int(rep.acting_primary[0]) == int(actp[0])
+
+
+def test_submit_many_mixed_pools_scatters_in_input_order(svc2):
+    m = svc2._active.m
+    p0, p1 = sorted(m.pools)[:2]
+    rng = np.random.default_rng(23)
+    pools = rng.choice([p0, p1], 240)
+    lo = min(m.pools[p0].pg_num, m.pools[p1].pg_num)
+    seeds = rng.integers(0, lo, 240).astype(np.uint32)
+    r = svc2.submit_many(pools, seeds)
+    assert r.ok and r.epoch == svc2.epoch
+    W = r.up.shape[1]
+    assert W == max(m.pools[p0].size, m.pools[p1].size)
+    for pid in (p0, p1):
+        mask = pools == pid
+        up, upp, act, actp = _oracle_rows(m, pid, seeds[mask], W)
+        assert np.array_equal(r.up[mask], up)
+        assert np.array_equal(r.acting[mask], act)
+        assert np.array_equal(r.acting_primary[mask], actp)
+    # scalar-pool fast path and the shape-mismatch EFAULT answer
+    one = svc2.submit_many([p0], seeds[:16])
+    assert one.ok and one.up.shape[1] == m.pools[p0].size
+    bad = svc2.submit_many(pools[:5], seeds[:7])
+    assert bad.counts() == {"EFAULT": 7} and "mismatch" in bad.error
+
+
+def test_closed_service_answers_eshutdown():
+    from ceph_tpu.serve.meshcheck import build_default
+
+    s = PlacementService(build_default(pgs=64, osds=8),
+                         config=_cfg(prewarm=False), name="test.shut")
+    s.close()
+    r = s.query_block(0, np.arange(8, dtype=np.uint32))
+    assert r.counts() == {"ESHUTDOWN": 8}
+    assert s.lookup(0, 0).status == "ESHUTDOWN"
+
+
+def test_serve_status_carries_bulk_and_swap_surface(svc):
+    st = svc.status()
+    assert st["bulk_blocks"] >= 1
+    assert st["bulk_lookups"] >= 1
+    assert st["structural_swap_stalls"] == 0
+    assert st["prewarmed_structures"] >= 2
+    assert st["config"]["bulk_max"] == svc.config.bulk_max
+    # micro-batch fill quantile: visible once the queued path ran
+    assert st["batch_fill_p50"] is not None
+    assert st["batch_fill_p99"] is not None
+    assert st["mesh"]["devices"] >= 1
+
+
+# -- mesh-sharded serving buffer --------------------------------------------
+
+@pytest.mark.slow
+def test_mesh_sharded_bulk_bit_identical_across_devices(svc2):
+    """The PG axis of the serving buffer shards over the forced-device
+    mesh exactly like ClusterState; the placement digest over every PG
+    of every pool must be bit-identical on 1 vs 2 devices (and match
+    the host oracle on both legs).  Slow: spawns a fresh interpreter
+    (full jax import) for the 2-device leg; the same identity is gated
+    every bench --selftest run."""
+    from ceph_tpu.serve.meshcheck import placement_digest
+
+    digest1, oracle1 = placement_digest(svc2, svc2._active.m)
+    assert oracle1
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CEPH_TPU_MESH_DEVICES="2",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    p = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.serve.meshcheck",
+         "--pgs", "64", "--osds", "8"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert p.returncode == 0, (p.returncode, p.stderr[-800:])
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["devices"] == 2
+    assert out["oracle_match"] is True
+    assert out["mesh"]["devices"] == 2
+    prov = out["mesh"]["provenance"]
+    assert prov["actual"] == 2 and not prov["degraded"]
+    assert out["digest"] == digest1
+
+
+# -- multi-replica front ----------------------------------------------------
+
+def test_front_bit_identical_and_staggered_fanout():
+    from ceph_tpu.serve.front import ServeFront
+
+    f = ServeFront(_map(), replicas=2, config=_cfg(), name="test.front")
+    try:
+        rng = np.random.default_rng(3)
+        seeds = rng.integers(0, N_PGS, 300).astype(np.uint32)
+        r = f.query_block(0, seeds)
+        assert r.ok and r.epoch == f.epoch
+        m = f.replicas[0]._active.m
+        up, upp, act, actp = _oracle_rows(m, 0, seeds, r.up.shape[1])
+        assert np.array_equal(r.up, up)
+        assert np.array_equal(r.acting, act)
+        assert np.array_equal(r.acting_primary, actp)
+        sc = f.lookup(0, int(seeds[0]))
+        assert sc.ok
+        assert np.array_equal(sc.acting[0], r.acting[0])
+        # staggered epoch fan-out: both replicas land the epoch, the
+        # front keeps answering, never two replicas staging at once
+        e0 = f.epoch
+        inc = Incremental(epoch=e0 + 1)
+        inc.new_weight[3] = int(0x10000 * 0.5)
+        res = f.apply(inc)
+        assert res["ok"] and f.epoch == e0 + 1
+        assert [rep.epoch for rep in f.replicas] == [e0 + 1, e0 + 1]
+        st = f.status()
+        assert st["front_staggered_swaps"] >= 1
+        assert st["staging"] == []
+        r2 = f.query_block(0, seeds)
+        assert r2.ok and r2.epoch == e0 + 1
+        m2 = f.replicas[0]._active.m
+        _, _, act2, actp2 = _oracle_rows(m2, 0, seeds, r2.up.shape[1])
+        assert np.array_equal(r2.acting, act2)
+        assert np.array_equal(r2.acting_primary, actp2)
+    finally:
+        f.close()
+
+
+def test_front_sheds_stalled_replica():
+    """An injected stall on ONE replica (`serve_dispatch.<name>`) is
+    absorbed: the front sheds the slow replica after one slow block,
+    remaps only its lanes (rendezvous exclusion), and every block
+    keeps answering ok."""
+    from ceph_tpu.serve.front import ServeFront
+
+    f = ServeFront(_map(), replicas=2, config=_cfg(), name="test.shed")
+    try:
+        seeds = np.arange(64, dtype=np.uint32)
+        for _ in range(3):  # settle both replicas' latency EWMA
+            assert f.query_block(0, seeds).ok
+        st0 = f.status()
+        faults.arm("serve_dispatch.test.shed.r1", "stall", "0.5", 1)
+        try:
+            replies = [f.query_block(0, seeds) for _ in range(6)]
+        finally:
+            faults.disarm("serve_dispatch.test.shed.r1")
+        assert all(r.ok for r in replies)  # absorbed, never surfaced
+        st = f.status()
+        assert st["front_replica_sheds"] > st0["front_replica_sheds"]
+        assert st["front_shed_routes"] > st0["front_shed_routes"]
+    finally:
+        f.close()
 
 
 # -- chaos + kill/restart (slow tier) ---------------------------------------

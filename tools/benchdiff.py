@@ -129,7 +129,23 @@ from pathlib import Path
 #     zero-baseline rule) and `pareto_front_size` (the non-dominated
 #     front must stay non-empty) — all but the rate bit-determined by
 #     the seeded member scenarios, compared raw.
-SCHEMA_VERSION = 12
+# v13: serve bulk protocol edge + mesh-sharded query blocks + the
+#     multi-replica front (serve/service.py query_block/submit_many,
+#     serve/meshcheck.py, serve/front.py).  The serve stage grows
+#     `bulk` (`serve.bulk_qps` — the bulk-edge lookup rate, the 1M/s
+#     headline, hardware-normalized; `serve.bulk_ratio` vs the scalar
+#     submit edge; `serve.bulk_compiles` booked inside the measured
+#     bulk window — 0 when both warmed shapes hold), `mesh`
+#     (`serve.mesh_devices` and the 1-vs-N `serve.mesh_digest_match`
+#     bit — bit-determined by the forced topology, raw),
+#     `structural_swap_stalls` (flips whose reader stall broke the
+#     bound across a FORCED structural swap — 0 when pre-traced
+#     variants + the warming thread hold; 0 -> N rides the structural
+#     zero-baseline rule) and `front` (`serve.front_p99_ms` — the
+#     client tail through the replica front under an injected
+#     one-replica stall, normalized; `serve.front_sheds` — the
+#     slowest-replica absorb firing under that seeded stall, raw).
+SCHEMA_VERSION = 13
 
 _ROUND_RE = re.compile(r"r(\d+)")
 
@@ -551,6 +567,25 @@ def extract_metrics(rec: dict) -> dict[str, tuple[float, bool, bool]]:
         sv.get("background_round_p99_ms"), False, True)
     put("serve.background_query_compiles",
         sv.get("background_query_compiles"), False, False)
+    # bulk edge + mesh + front (v13): the bulk rate and the front tail
+    # are hardware numbers — normalized; everything else is
+    # bit-determined by the forced topology and the seeded stall —
+    # raw (a stall appearing, the digest bit dropping, or a compile
+    # inside the bulk window is semantic drift, never jitter)
+    bk = sv.get("bulk") or {}
+    put("serve.bulk_qps", bk.get("qps"), True, True)
+    put("serve.bulk_ratio", bk.get("ratio"), True, False)
+    put("serve.bulk_compiles", bk.get("compiles"), False, False)
+    put("serve.structural_swap_stalls",
+        sv.get("structural_swap_stalls"), False, False)
+    mh = sv.get("mesh") or {}
+    put("serve.mesh_devices", mh.get("devices"), True, False)
+    if isinstance(mh.get("digest_match"), bool):
+        out["serve.mesh_digest_match"] = (
+            float(mh["digest_match"]), True, False)
+    fr = sv.get("front") or {}
+    put("serve.front_p99_ms", fr.get("p99_ms"), False, True)
+    put("serve.front_sheds", fr.get("sheds"), True, False)
     # fleet simulator (v12): the member scenarios are seeded, so the
     # digest-match count, steady compiles and the pareto front are
     # bit-determined — raw compares (digest_matches dropping below the

@@ -40,6 +40,7 @@ FAULT_REGISTRY = "ceph_tpu/runtime/faults.py"
 HEALTH_REGISTRY = "ceph_tpu/obs/health.py"
 EVENT_REGISTRY = "ceph_tpu/sim/lifetime.py"
 SWEEP_REGISTRY = "ceph_tpu/fleet/spec.py"
+REPLY_REGISTRY = "ceph_tpu/serve/service.py"
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w,-]+)")
 
@@ -239,6 +240,8 @@ class Context:
             self.root / SWEEP_REGISTRY, "SWEEP_AXES", {})
         self.fleet_knobs, self.fleet_knob_lines = _load_registry(
             self.root / SWEEP_REGISTRY, "FLEET_KNOBS", {})
+        self.reply_statuses, self.reply_lines = _load_registry(
+            self.root / REPLY_REGISTRY, "REPLY_STATUSES", {})
 
     @property
     def test_modules(self) -> list[Module]:

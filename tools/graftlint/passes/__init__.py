@@ -15,6 +15,7 @@ from tools.graftlint.passes import (  # noqa: F401
     host_sync,
     no_print,
     scenario_event,
+    serve_reply,
     span_name,
     sweep_grammar,
     trace_constant,

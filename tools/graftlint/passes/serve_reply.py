@@ -1,0 +1,145 @@
+"""serve-reply: reply statuses are declared, constructed, and pinned.
+
+`serve/service.py` declares the answer vocabulary (`REPLY_STATUSES`)
+the never-dropped contract is written in: every dispatcher path — the
+queued micro-batcher, the bulk protocol edge, the multi-replica front
+— must end each request (each lane, for bulk) in exactly one declared
+status.  Three static directions:
+
+- every `Reply("<status>", ...)` construction and every
+  `STATUS_CODES["<status>"]` lane code in a serve module must name a
+  declared status — an early-return path cannot invent an
+  undocumented answer code;
+- every declared status must be constructed by at least one serve
+  path (dead vocabulary otherwise) and must appear as a string
+  literal in at least one test — an answer code no test asserts is an
+  error path nobody has watched fire;
+- a function annotated `-> Reply` / `-> BulkReply` must never `return`
+  bare or `return None`: that is a silently dropped reply, the exact
+  bug the per-lane status contract exists to rule out.
+
+All directions are AST-only (no serve import); the registry and its
+line numbers come from `Context.reply_statuses`/`reply_lines`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    REPLY_REGISTRY, Context, Module, Pass, Violation, register,
+)
+
+_REPLY_TYPES = ("Reply", "BulkReply")
+
+
+def _is_serve_module(module: Module) -> bool:
+    return (module.rel.startswith("ceph_tpu/serve/")
+            or "serve" in module.rel.rsplit("/", 1)[-1])
+
+
+def _status_sites(module: Module):
+    """Yield (status, node, how) for every literal status a serve
+    module constructs: `Reply("X", ...)` first arguments and
+    `STATUS_CODES["X"]` subscripts."""
+    if module.tree is None:
+        return
+    for node in ast.walk(module.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Reply"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            yield node.args[0].value, node.args[0], "Reply()"
+        elif (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "STATUS_CODES"
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            yield node.slice.value, node.slice, "STATUS_CODES[]"
+
+
+def _dropped_replies(module: Module):
+    """Yield `return` nodes that drop a reply: bare return / return
+    None inside a function annotated -> Reply / -> BulkReply."""
+    if module.tree is None:
+        return
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        r = fn.returns
+        named = (isinstance(r, ast.Name) and r.id in _REPLY_TYPES) or (
+            isinstance(r, ast.Constant) and r.value in _REPLY_TYPES)
+        if not named:
+            continue
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs carry their own annotation
+            if isinstance(node, ast.Return) and (
+                    node.value is None
+                    or (isinstance(node.value, ast.Constant)
+                        and node.value.value is None)):
+                yield fn.name, node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class ServeReplyPass(Pass):
+    name = "serve-reply"
+    doc = "serve reply statuses declared/constructed; no dropped replies"
+
+    def run(self, ctx: Context) -> None:
+        if not ctx.reply_statuses:
+            return
+        serve = [m for m in ctx.modules if _is_serve_module(m)]
+        constructed: dict[str, int] = {}
+        for m in serve:
+            for status, node, how in _status_sites(m):
+                constructed.setdefault(status, node.lineno)
+                if status not in ctx.reply_statuses:
+                    ctx.report(m, node, self.name,
+                               f"{how} names status {status!r} that is "
+                               "not declared in REPLY_STATUSES — an "
+                               "undocumented answer code")
+            for fn_name, node in _dropped_replies(m):
+                ctx.report(m, node, self.name,
+                           f"{fn_name}() is annotated to return a "
+                           "reply but this path returns none — a "
+                           "dropped reply breaks the never-dropped "
+                           "contract")
+
+        # reverse direction only against the real registry home: a
+        # fixture module alone cannot prove vocabulary dead
+        if any(m.rel.endswith("serve/service.py") for m in serve):
+            for status in sorted(ctx.reply_statuses):
+                if status not in constructed:
+                    ctx.violations.append(Violation(
+                        REPLY_REGISTRY, ctx.reply_lines.get(status, 1),
+                        self.name,
+                        f"declared status {status!r} is constructed by "
+                        "no serve path — dead vocabulary",
+                    ))
+
+        if not ctx.test_modules:
+            return
+        pinned: set[str] = set()
+        for tm in ctx.test_modules:
+            if tm.tree is None:
+                continue
+            for node in ast.walk(tm.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str) \
+                        and node.value in ctx.reply_statuses:
+                    pinned.add(node.value)
+        for status in sorted(ctx.reply_statuses):
+            if status not in pinned:
+                ctx.violations.append(Violation(
+                    REPLY_REGISTRY, ctx.reply_lines.get(status, 1),
+                    self.name,
+                    f"declared status {status!r} is asserted by no "
+                    "test literal — an answer path nobody has watched "
+                    "fire",
+                ))
